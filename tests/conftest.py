@@ -1,0 +1,51 @@
+"""Test config: fake an 8-device CPU mesh before JAX's CPU client exists.
+
+The reference tested on real multi-GPU clusters with no fakes (SURVEY
+§4); the rebuild tests every collective on a virtual 8-device CPU mesh
+so the suite runs anywhere.
+
+In this image an axon ``sitecustomize`` imports JAX and registers the
+TPU PJRT plugin at interpreter startup, so ``JAX_PLATFORMS=cpu`` set
+here is too late to change the *default* backend.  But the CPU client
+is still created lazily — setting ``XLA_FLAGS`` now (before anything
+touches the CPU backend) gives us 8 virtual CPU devices alongside the
+TPU, and ``jax_default_device`` + ``TM_TPU_PLATFORM=cpu`` steer both
+JAX and this framework's device discovery onto them.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# Framework-level device discovery (theanompi_tpu.parallel.mesh) reads this.
+os.environ["TM_TPU_PLATFORM"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_default_device", jax.devices("cpu")[0])
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices("cpu")
+    assert len(devs) >= 8, f"expected 8 fake devices, got {len(devs)}"
+    return devs[:8]
+
+
+@pytest.fixture()
+def mesh8(devices8):
+    from theanompi_tpu.parallel import make_mesh
+
+    return make_mesh(data=8, devices=devices8)
+
+
+@pytest.fixture()
+def rng():
+    import numpy as np
+
+    return np.random.default_rng(0)

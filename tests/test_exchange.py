@@ -1,0 +1,244 @@
+"""Unit tests for the exchange-rule math against numpy (SURVEY §4b)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+from theanompi_tpu.parallel import (
+    DATA_AXIS,
+    allreduce_mean,
+    elastic_pair_update,
+    get_strategy,
+    gossip_merge,
+    gossip_push,
+    make_mesh,
+)
+from theanompi_tpu.parallel.exchange import (
+    elastic_center_merge,
+    replica_consistency_delta,
+)
+
+
+def _tree(rng, scale=1.0):
+    return {
+        "w": jnp.asarray(rng.normal(size=(8, 4, 3)) * scale, jnp.float32),
+        "b": jnp.asarray(rng.normal(size=(5,)) * scale, jnp.float32),
+    }
+
+
+def _per_device_trees(rng, n=8):
+    """n distinct pytrees, stacked on a leading device axis."""
+    trees = [_tree(rng) for _ in range(n)]
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *trees), trees
+
+
+class TestAllreduce:
+    @pytest.mark.parametrize("strategy", ["ar", "asa32", "asa16", "nccl32", "nccl16"])
+    def test_strategies_mean(self, mesh8, rng, strategy):
+        stacked, trees = _per_device_trees(rng)
+        strat = get_strategy(strategy)
+
+        fn = shard_map(
+            lambda t: jax.tree.map(
+                lambda x: x[None],
+                strat(jax.tree.map(lambda x: x[0], t), DATA_AXIS),
+            ),
+            mesh=mesh8,
+            in_specs=P(DATA_AXIS),
+            out_specs=P(DATA_AXIS),
+        )
+        # out has a size-1 leading axis per device -> gathered to [8, ...]
+        out = jax.jit(fn)(stacked)
+
+        want = jax.tree.map(lambda *xs: np.mean(xs, axis=0), *trees)
+        tol = 2e-2 if strategy.endswith("16") else 1e-5
+        for k in ("w", "b"):
+            got0 = np.asarray(out[k][0])
+            gotlast = np.asarray(out[k][-1])
+            np.testing.assert_allclose(got0, want[k], rtol=tol, atol=tol)
+            # every replica must hold the identical mean
+            np.testing.assert_array_equal(got0, gotlast)
+
+    def test_wire_dtype_preserves_param_dtype(self, mesh8, rng):
+        stacked, _ = _per_device_trees(rng)
+        fn = shard_map(
+            lambda t: jax.tree.map(
+                lambda x: x[None],
+                allreduce_mean(
+                    jax.tree.map(lambda x: x[0], t),
+                    DATA_AXIS,
+                    wire_dtype=jnp.bfloat16,
+                ),
+            ),
+            mesh=mesh8,
+            in_specs=P(DATA_AXIS),
+            out_specs=P(DATA_AXIS),
+        )
+        out = jax.jit(fn)(stacked)
+        assert out["w"].dtype == jnp.float32
+
+    def test_two_phase_matches_psum(self, mesh8, rng):
+        stacked, _ = _per_device_trees(rng)
+        def run(two_phase):
+            fn = shard_map(
+                lambda t: jax.tree.map(
+                    lambda x: x[None],
+                    allreduce_mean(
+                        jax.tree.map(lambda x: x[0], t),
+                        DATA_AXIS,
+                        two_phase=two_phase,
+                    ),
+                ),
+                mesh=mesh8,
+                in_specs=P(DATA_AXIS),
+                out_specs=P(DATA_AXIS),
+            )
+            return jax.jit(fn)(stacked)
+        a, b = run(False), run(True)
+        np.testing.assert_allclose(np.asarray(a["w"]), np.asarray(b["w"]), rtol=1e-6)
+
+
+class TestEASGD:
+    def test_elastic_pair_math(self, rng):
+        local = _tree(rng)
+        center = _tree(rng)
+        alpha = 0.25
+        new_l, new_c = jax.jit(lambda l, c: elastic_pair_update(l, c, alpha))(
+            local, center
+        )
+        for k in local:
+            diff = alpha * (np.asarray(local[k]) - np.asarray(center[k]))
+            np.testing.assert_allclose(np.asarray(new_l[k]),
+                                       np.asarray(local[k]) - diff, rtol=1e-6)
+            np.testing.assert_allclose(np.asarray(new_c[k]),
+                                       np.asarray(center[k]) + diff, rtol=1e-6)
+
+    def test_elastic_fixed_point(self, rng):
+        """When local == center the exchange is a no-op."""
+        t = _tree(rng)
+        new_l, new_c = elastic_pair_update(t, t, 0.5)
+        for k in t:
+            np.testing.assert_array_equal(np.asarray(new_l[k]), np.asarray(t[k]))
+            np.testing.assert_array_equal(np.asarray(new_c[k]), np.asarray(t[k]))
+
+    def test_center_merge_sums_pushes(self, rng):
+        stacked, trees = _per_device_trees(rng, n=4)
+        center = _tree(rng)
+        alpha = 0.1
+        new_w, new_c = jax.jit(
+            lambda w, c: elastic_center_merge(w, c, alpha)
+        )(stacked, center)
+        for k in center:
+            pushes = sum(
+                alpha * (np.asarray(t[k]) - np.asarray(center[k])) for t in trees
+            )
+            np.testing.assert_allclose(
+                np.asarray(new_c[k]), np.asarray(center[k]) + pushes, rtol=1e-5
+            )
+            np.testing.assert_allclose(
+                np.asarray(new_w[k][2]),
+                np.asarray(trees[2][k])
+                - alpha * (np.asarray(trees[2][k]) - np.asarray(center[k])),
+                rtol=1e-5,
+            )
+
+
+class TestGoSGD:
+    def test_merge_math(self, rng):
+        a, b = _tree(rng), _tree(rng)
+        sa, sb = jnp.float32(0.5), jnp.float32(0.25)
+        merged, total = gossip_merge(a, sa, b, sb)
+        assert float(total) == pytest.approx(0.75)
+        for k in a:
+            want = (0.5 * np.asarray(a[k]) + 0.25 * np.asarray(b[k])) / 0.75
+            np.testing.assert_allclose(np.asarray(merged[k]), want, rtol=1e-6)
+
+    def test_gossip_push_round(self, mesh8, rng):
+        n = 8
+        stacked, trees = _per_device_trees(rng, n)
+        scores = jnp.ones((n, 1), jnp.float32)  # [device, 1] scalar score each
+        # ring permutation: i -> i+1; devices 0 and 3 push
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        pushing = jnp.zeros((n,), jnp.float32).at[0].set(1).at[3].set(1)
+
+        def step(params, score):
+            p = jax.tree.map(lambda x: x[0], params)
+            merged, total = gossip_push(
+                p, score[0], axis_name=DATA_AXIS, perm=perm, pushing=pushing
+            )
+            return (
+                jax.tree.map(lambda x: x[None], merged),
+                total[None],
+            )
+
+        fn = shard_map(
+            step, mesh=mesh8,
+            in_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+            out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+        )
+        merged, totals = jax.jit(fn)(stacked, scores)
+        totals = np.asarray(totals).ravel()
+
+        # pusher 0: kept 0.5, received nothing (7 didn't push) -> 0.5
+        assert totals[0] == pytest.approx(0.5)
+        # receiver 1: own 1.0 + 0.5 from 0 -> 1.5, params merged 2:1
+        assert totals[1] == pytest.approx(1.5)
+        want1 = (1.0 * np.asarray(trees[1]["w"]) + 0.5 * np.asarray(trees[0]["w"])) / 1.5
+        np.testing.assert_allclose(np.asarray(merged["w"][1]), want1, rtol=1e-5)
+        # bystander 5: unchanged params, score 1.0
+        assert totals[5] == pytest.approx(1.0)
+        np.testing.assert_allclose(
+            np.asarray(merged["w"][5]), np.asarray(trees[5]["w"]), rtol=1e-6
+        )
+        # score mass is conserved
+        assert totals.sum() == pytest.approx(n)
+
+    def test_no_push_is_identity(self, mesh8, rng):
+        n = 8
+        stacked, trees = _per_device_trees(rng, n)
+        scores = jnp.ones((n, 1), jnp.float32)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        pushing = jnp.zeros((n,), jnp.float32)
+
+        def step(params, score):
+            p = jax.tree.map(lambda x: x[0], params)
+            merged, total = gossip_push(
+                p, score[0], axis_name=DATA_AXIS, perm=perm, pushing=pushing
+            )
+            return jax.tree.map(lambda x: x[None], merged), total[None]
+
+        fn = shard_map(step, mesh=mesh8,
+                       in_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+                       out_specs=(P(DATA_AXIS), P(DATA_AXIS)))
+        merged, totals = jax.jit(fn)(stacked, scores)
+        np.testing.assert_allclose(np.asarray(merged["w"]),
+                                   np.asarray(stacked["w"]), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(totals).ravel(), np.ones(n))
+
+
+class TestConsistencyCheck:
+    def test_delta_zero_when_synced(self, mesh8, rng):
+        t = _tree(rng)
+        stacked = jax.tree.map(lambda x: jnp.broadcast_to(x, (8,) + x.shape), t)
+        fn = shard_map(
+            lambda s: replica_consistency_delta(
+                jax.tree.map(lambda x: x[0], s), DATA_AXIS
+            )[None],
+            mesh=mesh8, in_specs=P(DATA_AXIS), out_specs=P(DATA_AXIS),
+        )
+        delta = jax.jit(fn)(stacked)
+        assert float(np.max(np.asarray(delta))) < 1e-6
+
+    def test_delta_positive_when_diverged(self, mesh8, rng):
+        stacked, _ = _per_device_trees(rng)
+        fn = shard_map(
+            lambda s: replica_consistency_delta(
+                jax.tree.map(lambda x: x[0], s), DATA_AXIS
+            )[None],
+            mesh=mesh8, in_specs=P(DATA_AXIS), out_specs=P(DATA_AXIS),
+        )
+        delta = jax.jit(fn)(stacked)
+        assert float(np.max(np.asarray(delta))) > 0.1

@@ -1,0 +1,172 @@
+"""Process launcher + ``tmlauncher`` CLI.
+
+TPU-native replacement of the reference's launcher (reference:
+``theanompi/launcher.py`` + ``tmlauncher`` console entry): where the
+reference assembled ``mpirun -np N ... python -m theanompi.bsp_worker
+<device> <modelfile> <modelclass>``, this launcher either
+
+- runs the worker **in-process** (single-controller SPMD — one Python
+  process drives every local chip; no mpirun needed at all on a single
+  host), or
+- spawns ONE detached controller subprocess (so ``rule.init()`` returns
+  immediately and ``rule.wait()`` joins, matching reference behavior), or
+- for multi-host pods: ``tmlauncher --coordinator host:port
+  --num-hosts H --host-id I ...`` runs on every host and calls
+  ``jax.distributed.initialize`` — the mpirun/NCCL-clique replacement;
+  XLA then treats the whole pod as one mesh.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import os
+import subprocess
+import sys
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence
+
+
+@dataclass
+class LaunchHandle:
+    mode: str
+    proc: Optional[subprocess.Popen] = None
+    result: Any = None
+
+    def wait(self) -> Any:
+        if self.mode == "subprocess" and self.proc is not None:
+            rc = self.proc.wait()
+            if rc != 0:
+                raise RuntimeError(f"worker process exited with code {rc}")
+            return rc
+        return self.result
+
+    def poll(self) -> Optional[int]:
+        if self.proc is not None:
+            return self.proc.poll()
+        return 0
+
+
+def _run_worker_inprocess(
+    worker_module: str,
+    devices: Sequence[Any] | None,
+    modelfile: str,
+    modelclass: str,
+    rule_kwargs: dict,
+) -> Any:
+    mod = importlib.import_module(worker_module)
+    return mod.run(
+        devices=devices,
+        modelfile=modelfile,
+        modelclass=modelclass,
+        **rule_kwargs,
+    )
+
+
+def launch(
+    worker_module: str,
+    devices: Sequence[Any] | None,
+    modelfile: str,
+    modelclass: str,
+    mode: str = "subprocess",
+    rule_kwargs: dict | None = None,
+) -> LaunchHandle:
+    rule_kwargs = dict(rule_kwargs or {})
+    if mode == "inprocess":
+        result = _run_worker_inprocess(
+            worker_module, devices, modelfile, modelclass, rule_kwargs
+        )
+        return LaunchHandle(mode=mode, result=result)
+    if mode == "subprocess":
+        spec = {
+            "devices": list(devices) if devices is not None else None,
+            "modelfile": modelfile,
+            "modelclass": modelclass,
+            "kwargs": rule_kwargs,
+        }
+        cmd = [
+            sys.executable,
+            "-m",
+            worker_module,
+            "--spec-json",
+            json.dumps(spec),
+        ]
+        proc = subprocess.Popen(cmd, env=os.environ.copy())
+        return LaunchHandle(mode=mode, proc=proc)
+    raise ValueError(f"unknown launch mode {mode!r}")
+
+
+def worker_main(run_fn) -> Any:
+    """Entry for ``python -m theanompi_tpu.workers.X --spec-json ...``."""
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--spec-json", required=True)
+    ns = ap.parse_args()
+    spec = json.loads(ns.spec_json)
+    return run_fn(
+        devices=spec.get("devices"),
+        modelfile=spec["modelfile"],
+        modelclass=spec["modelclass"],
+        **spec.get("kwargs", {}),
+    )
+
+
+# ---------------------------------------------------------------------------
+# tmlauncher CLI (reference: `tmlauncher` console script)
+# ---------------------------------------------------------------------------
+
+def init_distributed(
+    coordinator: Optional[str],
+    num_hosts: Optional[int],
+    host_id: Optional[int],
+) -> None:
+    """Join a multi-host pod run. Replaces the reference's mpirun +
+    NCCL-clique bootstrap with ``jax.distributed.initialize`` over DCN."""
+    if coordinator is None:
+        return
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_hosts,
+        process_id=host_id,
+    )
+
+
+def main(argv: Sequence[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tmlauncher",
+        description="Launch theanompi_tpu training (mpirun replacement).",
+    )
+    ap.add_argument("rule", choices=["BSP", "EASGD", "GOSGD"])
+    ap.add_argument("modelfile", help="e.g. theanompi_tpu.models.wresnet")
+    ap.add_argument("modelclass", help="e.g. WResNet")
+    ap.add_argument("--devices", type=int, default=None,
+                    help="number of local chips to use (default: all)")
+    ap.add_argument("--coordinator", default=None,
+                    help="host:port of process 0 for multi-host runs")
+    ap.add_argument("--num-hosts", type=int, default=None)
+    ap.add_argument("--host-id", type=int, default=None)
+    ap.add_argument("--kwargs", default="{}",
+                    help="JSON dict of extra rule/worker kwargs")
+    ns = ap.parse_args(argv)
+
+    init_distributed(ns.coordinator, ns.num_hosts, ns.host_id)
+
+    import theanompi_tpu as tm
+
+    rule = getattr(tm, ns.rule)()
+    devices = list(range(ns.devices)) if ns.devices is not None else None
+    rule.init(
+        devices=devices,
+        modelfile=ns.modelfile,
+        modelclass=ns.modelclass,
+        launch="inprocess",
+        **json.loads(ns.kwargs),
+    )
+    rule.wait()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,46 @@
+"""Parallelism layer: device meshes, exchange rules, and wire strategies.
+
+TPU-native replacement for the reference's comm stack
+(``theanompi/lib/exchanger.py`` + ``exchanger_strategy.py`` +
+mpi4py/NCCL): collectives are emitted by XLA over ICI from
+``shard_map``-ed pure functions, rather than called explicitly on
+parameter buffers between train steps.
+"""
+
+from theanompi_tpu.parallel.mesh import (
+    make_mesh,
+    data_axis,
+    default_devices,
+    DATA_AXIS,
+    MODEL_AXIS,
+    SEQ_AXIS,
+    num_devices,
+)
+from theanompi_tpu.parallel.exchange import (
+    allreduce_mean,
+    elastic_pair_update,
+    gossip_push,
+    gossip_merge,
+)
+from theanompi_tpu.parallel.strategies import (
+    ExchangeStrategy,
+    get_strategy,
+    STRATEGIES,
+)
+
+__all__ = [
+    "make_mesh",
+    "data_axis",
+    "default_devices",
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "SEQ_AXIS",
+    "num_devices",
+    "allreduce_mean",
+    "elastic_pair_update",
+    "gossip_push",
+    "gossip_merge",
+    "ExchangeStrategy",
+    "get_strategy",
+    "STRATEGIES",
+]

@@ -1,0 +1,80 @@
+"""Device-mesh construction helpers.
+
+The reference binds one OS process per GPU and wires them with MPI ranks
+(reference: ``theanompi/lib/base.py`` — ``MPI_GPU_Process``: COMM_WORLD
+setup + intra-node NCCL clique).  The TPU-native equivalent is a
+`jax.sharding.Mesh` over all addressable devices: the "rank" becomes a
+mesh coordinate, and the NCCL clique becomes the ICI fabric that XLA
+collectives ride for free.
+
+Axis conventions (used throughout the framework):
+
+- ``data``  — data parallelism (the reference's only axis).
+- ``model`` — tensor parallelism (new-framework scope; the reference's
+  predecessor ``theano_alexnet`` had a 2-GPU model-parallel AlexNet).
+- ``seq``   — sequence/context parallelism for ring attention
+  (new-framework scope; Llama-3-8B stretch config).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+
+
+def default_devices() -> list[jax.Device]:
+    """Devices the framework builds meshes from.
+
+    ``TM_TPU_PLATFORM`` overrides the platform (the test suite sets it
+    to ``cpu`` to use the virtual 8-device host mesh even when a TPU
+    backend is registered).
+    """
+    plat = os.environ.get("TM_TPU_PLATFORM")
+    return jax.devices(plat) if plat else jax.devices()
+
+
+def num_devices() -> int:
+    return len(default_devices())
+
+
+def make_mesh(
+    data: int | None = None,
+    model: int = 1,
+    seq: int = 1,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a ``Mesh`` with ``(data, model, seq)`` axes.
+
+    ``data=None`` means "all remaining devices after model×seq".  On a
+    real slice the device order from ``jax.devices()`` already follows
+    the physical torus, so contiguous reshaping keeps the ``model`` and
+    ``seq`` axes on nearest-neighbour ICI links (these axes carry the
+    latency-sensitive collectives: TP psums and ring-attention
+    ppermutes), while ``data`` — bandwidth-bound but latency-tolerant
+    allreduces — spans the outer dimension.
+    """
+    devs = list(devices) if devices is not None else default_devices()
+    n = len(devs)
+    if model * seq > n:
+        raise ValueError(f"model*seq={model * seq} exceeds {n} devices")
+    if data is None:
+        data = n // (model * seq)
+    want = data * model * seq
+    if want > n:
+        raise ValueError(f"mesh {data}x{model}x{seq}={want} exceeds {n} devices")
+    grid = np.array(devs[:want]).reshape(data, model, seq)
+    return Mesh(grid, (DATA_AXIS, MODEL_AXIS, SEQ_AXIS))
+
+
+def data_axis(mesh: Mesh) -> int:
+    """Size of the data-parallel axis of ``mesh``."""
+    return mesh.shape[DATA_AXIS]

@@ -1,0 +1,121 @@
+"""User-facing synchronization-rule classes.
+
+Preserves the reference's rule API surface (reference:
+``theanompi/__init__.py`` — ``BSP``, ``EASGD``, ``GOSGD`` classes with
+``.init(...)`` / ``.wait()``):
+
+    rule = BSP()
+    rule.init(devices=[0, 1], modelfile='theanompi_tpu.models.wresnet',
+              modelclass='WResNet')
+    rule.wait()
+
+Semantics shift for TPU: the reference's ``init`` assembled an
+``mpirun -np N`` command line, one OS process per GPU.  Here ``init``
+either (default) launches ONE controller process driving all requested
+chips through a mesh (SPMD — the idiomatic path), or runs the worker
+loop in-process (``launch='inprocess'``, used by tests and notebooks).
+Multi-host pods use ``tmlauncher`` (see ``launcher.py``) which wraps
+``jax.distributed.initialize`` — the mpirun replacement.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+from theanompi_tpu import launcher as _launcher
+
+
+class Rule:
+    """Base synchronization rule (façade over the launcher)."""
+
+    #: worker module run per controller, overridden by subclasses
+    worker_module: str = ""
+
+    def __init__(self) -> None:
+        self._handle: Optional[_launcher.LaunchHandle] = None
+        self.result: Any = None
+
+    def init(
+        self,
+        devices: Sequence[Any] | None = None,
+        modelfile: str = "",
+        modelclass: str = "",
+        *,
+        launch: str = "subprocess",
+        **kwargs: Any,
+    ) -> None:
+        """Start training ``modelclass`` from ``modelfile`` on ``devices``.
+
+        ``devices`` — device indices / names (reference passed gpu
+        strings like ``'cuda0'``); on TPU this selects how many chips
+        join the data-parallel mesh (None = all).
+        ``launch`` — ``'subprocess'`` (reference-style detached run) or
+        ``'inprocess'`` (blocking, returns worker's result at wait()).
+        """
+        if not modelfile or not modelclass:
+            raise ValueError("modelfile and modelclass are required")
+        self._handle = _launcher.launch(
+            worker_module=self.worker_module,
+            devices=devices,
+            modelfile=modelfile,
+            modelclass=modelclass,
+            mode=launch,
+            rule_kwargs=kwargs,
+        )
+
+    def wait(self) -> Any:
+        """Block until training finishes (reference: join the mpirun)."""
+        if self._handle is None:
+            raise RuntimeError("call init() before wait()")
+        self.result = self._handle.wait()
+        return self.result
+
+
+class BSP(Rule):
+    """Bulk-synchronous parallel: gradient mean-allreduce every step.
+
+    Reference: ``BSP`` rule + ``BSP_Worker`` + ``BSP_Exchanger``.
+    """
+
+    worker_module = "theanompi_tpu.workers.bsp_worker"
+
+
+class EASGD(Rule):
+    """Elastic-averaging SGD (Zhang et al. 2015): async center/worker.
+
+    Reference: ``EASGD`` rule + ``EASGD_Server``/``EASGD_Worker``.
+    ``init`` accepts ``server=...`` and ``workers=[...]`` like the
+    reference's async launch; on TPU the center lives as a replicated
+    ``jax.Array`` and workers are per-device model replicas exchanging
+    every ``tau`` steps.
+    """
+
+    worker_module = "theanompi_tpu.workers.easgd_worker"
+
+    def init(  # type: ignore[override]
+        self,
+        server: Any = None,
+        workers: Sequence[Any] | None = None,
+        devices: Sequence[Any] | None = None,
+        modelfile: str = "",
+        modelclass: str = "",
+        **kwargs: Any,
+    ) -> None:
+        if devices is None and workers is not None:
+            devices = list(workers)
+        kwargs.setdefault("server_device", server)
+        super().init(
+            devices=devices,
+            modelfile=modelfile,
+            modelclass=modelclass,
+            **kwargs,
+        )
+
+
+class GOSGD(Rule):
+    """Gossip SGD (Blot et al. 2016): randomized peer push + merge.
+
+    Reference: ``GOSGD`` rule + ``GOSGD_Worker``.
+    """
+
+    worker_module = "theanompi_tpu.workers.gosgd_worker"

@@ -1,0 +1,6 @@
+"""Per-rule worker loops (reference: ``theanompi/bsp_worker.py``,
+``easgd_server.py``/``easgd_worker.py``, ``gosgd_worker.py``).
+
+Each module exposes ``run(devices, modelfile, modelclass, **kwargs)``
+driving the single-controller SPMD training loop for its rule.
+"""
