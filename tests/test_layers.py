@@ -1,0 +1,152 @@
+"""Layer-library unit tests; torch (CPU) is the independent oracle for
+conv/pool/LRN numerics — the reference validated against cuDNN behavior."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as F  # noqa: E402
+
+from theanompi_tpu.ops import (
+    BN,
+    FC,
+    LRN,
+    Activation,
+    Conv,
+    Dropout,
+    Flatten,
+    Pool,
+    Sequential,
+    accuracy,
+    initializers,
+    softmax_cross_entropy,
+)
+
+KEY = jax.random.PRNGKey(0)
+
+
+def test_conv_matches_torch(rng):
+    x = rng.normal(size=(2, 8, 8, 3)).astype(np.float32)
+    layer = Conv(4, 3, stride=1, pad="SAME")
+    params, state, out_shape = layer.init(KEY, (8, 8, 3))
+    assert out_shape == (8, 8, 4)
+    y, _ = layer.apply(params, state, jnp.asarray(x))
+
+    w = np.asarray(params["w"])  # HWIO
+    tw = torch.tensor(w.transpose(3, 2, 0, 1))  # OIHW
+    tx = torch.tensor(x.transpose(0, 3, 1, 2))  # NCHW
+    ty = F.conv2d(tx, tw, torch.tensor(np.asarray(params["b"])), padding=1)
+    np.testing.assert_allclose(
+        np.asarray(y), ty.numpy().transpose(0, 2, 3, 1), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_conv_stride_pad_shapes():
+    layer = Conv(16, (5, 5), stride=2, pad=2)
+    params, _, out_shape = layer.init(KEY, (32, 32, 3))
+    assert out_shape == (16, 16, 16)
+    x = jnp.zeros((4, 32, 32, 3))
+    y, _ = layer.apply(params, {}, x)
+    assert y.shape == (4, 16, 16, 16)
+
+
+def test_pool_max_avg_match_torch(rng):
+    x = rng.normal(size=(2, 8, 8, 3)).astype(np.float32)
+    tx = torch.tensor(x.transpose(0, 3, 1, 2))
+    for mode, tfn in [("max", F.max_pool2d), ("avg", F.avg_pool2d)]:
+        layer = Pool(2, 2, mode=mode)
+        _, _, out_shape = layer.init(KEY, (8, 8, 3))
+        assert out_shape == (4, 4, 3)
+        y, _ = layer.apply({}, {}, jnp.asarray(x))
+        ty = tfn(tx, 2, 2).numpy().transpose(0, 2, 3, 1)
+        np.testing.assert_allclose(np.asarray(y), ty, rtol=1e-5, atol=1e-6)
+
+
+def test_lrn_matches_torch(rng):
+    x = rng.normal(size=(2, 4, 4, 7)).astype(np.float32)
+    layer = LRN(n=5, k=2.0, alpha=1e-4, beta=0.75)
+    y, _ = layer.apply({}, {}, jnp.asarray(x))
+    tx = torch.tensor(x.transpose(0, 3, 1, 2))
+    ty = F.local_response_norm(tx, size=5, alpha=1e-4, beta=0.75, k=2.0)
+    np.testing.assert_allclose(
+        np.asarray(y), ty.numpy().transpose(0, 2, 3, 1), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_bn_train_eval(rng):
+    x = rng.normal(loc=3.0, scale=2.0, size=(16, 4, 4, 5)).astype(np.float32)
+    layer = BN(momentum=0.5)
+    params, state, _ = layer.init(KEY, (4, 4, 5))
+    y, new_state = layer.apply(params, state, jnp.asarray(x), train=True)
+    # normalized output: ~0 mean, ~1 var per channel
+    ym = np.asarray(y).reshape(-1, 5)
+    np.testing.assert_allclose(ym.mean(0), 0, atol=1e-5)
+    np.testing.assert_allclose(ym.std(0), 1, atol=1e-3)
+    # running stats moved toward batch stats
+    batch_mean = x.reshape(-1, 5).mean(0)
+    np.testing.assert_allclose(
+        np.asarray(new_state["mean"]), 0.5 * batch_mean, rtol=1e-4
+    )
+    # eval mode uses running stats, not batch stats
+    y2, s2 = layer.apply(params, new_state, jnp.asarray(x), train=False)
+    assert s2 is new_state or np.allclose(
+        np.asarray(s2["mean"]), np.asarray(new_state["mean"])
+    )
+
+
+def test_dropout(rng):
+    x = jnp.ones((1000, 32))
+    layer = Dropout(0.4)
+    y_eval, _ = layer.apply({}, {}, x, train=False)
+    np.testing.assert_array_equal(np.asarray(y_eval), np.asarray(x))
+    y, _ = layer.apply({}, {}, x, train=True, rng=KEY)
+    arr = np.asarray(y)
+    # inverted dropout: surviving values scaled by 1/keep, mean preserved
+    uniq = np.unique(arr)
+    assert all(np.isclose(u, 0.0) or np.isclose(u, 1 / 0.6) for u in uniq)
+    assert abs(arr.mean() - 1.0) < 0.05
+
+
+def test_fc_and_sequential_mlp(rng):
+    model = Sequential([
+        Flatten(),
+        FC(32),
+        Activation("relu"),
+        Dropout(0.1),
+        FC(10),
+    ])
+    params, state, out_shape = model.init(KEY, (4, 4, 2))
+    assert out_shape == (10,)
+    x = jnp.asarray(rng.normal(size=(8, 4, 4, 2)), jnp.float32)
+    y, _ = model.apply(params, state, x, train=True, rng=KEY)
+    assert y.shape == (8, 10)
+    # eval is deterministic
+    y1, _ = model.apply(params, state, x)
+    y2, _ = model.apply(params, state, x)
+    np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))
+
+
+def test_loss_and_accuracy():
+    logits = jnp.asarray([[2.0, 0.0, 0.0], [0.0, 3.0, 0.0]])
+    labels = jnp.asarray([0, 1])
+    loss = softmax_cross_entropy(logits, labels)
+    want = -np.mean([
+        np.log(np.exp(2) / (np.exp(2) + 2)),
+        np.log(np.exp(3) / (np.exp(3) + 2)),
+    ])
+    assert float(loss) == pytest.approx(want, rel=1e-5)
+    assert float(accuracy(logits, labels)) == 1.0
+    assert float(accuracy(logits, jnp.asarray([1, 1]))) == 0.5
+    assert float(accuracy(logits, jnp.asarray([1, 1]), k=2)) == 1.0
+
+
+def test_initializer_fans():
+    he = initializers.he()
+    w = he(KEY, (3, 3, 64, 128))
+    # std should be ~sqrt(2/fan_in), fan_in = 3*3*64
+    assert float(jnp.std(w)) == pytest.approx((2 / (9 * 64)) ** 0.5, rel=0.1)
+    xa = initializers.xavier()(KEY, (100, 200))
+    limit = (6 / 300) ** 0.5
+    assert float(jnp.max(jnp.abs(xa))) <= limit + 1e-6

@@ -1,0 +1,89 @@
+"""Optimizer math vs hand-rolled numpy (reference: lib/opt.py updates)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from theanompi_tpu.ops import adam, momentum, nesterov, sgd
+
+
+def _params(rng):
+    return {"w": jnp.asarray(rng.normal(size=(4, 3)), jnp.float32),
+            "b": jnp.asarray(rng.normal(size=(3,)), jnp.float32)}
+
+
+def _grads(rng):
+    return {"w": jnp.asarray(rng.normal(size=(4, 3)), jnp.float32),
+            "b": jnp.asarray(rng.normal(size=(3,)), jnp.float32)}
+
+
+def test_sgd_step(rng):
+    p, g = _params(rng), _grads(rng)
+    opt = sgd(weight_decay=0.1)
+    st = opt.init(p)
+    new_p, _ = opt.update(p, g, st, 0.5)
+    for k in p:
+        want = np.asarray(p[k]) - 0.5 * (np.asarray(g[k]) + 0.1 * np.asarray(p[k]))
+        np.testing.assert_allclose(np.asarray(new_p[k]), want, rtol=1e-6)
+
+
+def test_momentum_two_steps(rng):
+    p, g = _params(rng), _grads(rng)
+    opt = momentum(mu=0.9)
+    st = opt.init(p)
+    p1, st1 = opt.update(p, g, st, 0.1)
+    p2, st2 = opt.update(p1, g, st1, 0.1)
+    v1 = -0.1 * np.asarray(g["w"])
+    want1 = np.asarray(p["w"]) + v1
+    np.testing.assert_allclose(np.asarray(p1["w"]), want1, rtol=1e-5)
+    v2 = 0.9 * v1 - 0.1 * np.asarray(g["w"])
+    np.testing.assert_allclose(np.asarray(p2["w"]), want1 + v2, rtol=1e-5)
+
+
+def test_nesterov_step(rng):
+    p, g = _params(rng), _grads(rng)
+    opt = nesterov(mu=0.9)
+    st = opt.init(p)
+    p1, st1 = opt.update(p, g, st, 0.1)
+    v1 = -0.1 * np.asarray(g["w"])
+    want = np.asarray(p["w"]) + 0.9 * v1 - 0.1 * np.asarray(g["w"])
+    np.testing.assert_allclose(np.asarray(p1["w"]), want, rtol=1e-5)
+
+
+def test_adam_first_step_is_lr_sized(rng):
+    p, g = _params(rng), _grads(rng)
+    opt = adam()
+    st = opt.init(p)
+    p1, st1 = opt.update(p, g, st, 1e-3)
+    # bias-corrected first step ~= lr * sign(g)
+    step = np.asarray(p["w"]) - np.asarray(p1["w"])
+    np.testing.assert_allclose(step, 1e-3 * np.sign(np.asarray(g["w"])), rtol=1e-3)
+    assert int(st1["t"]) == 1
+
+
+def test_optimizers_jittable(rng):
+    p, g = _params(rng), _grads(rng)
+    for opt in (sgd(), momentum(), nesterov(), adam()):
+        st = opt.init(p)
+        new_p, _ = jax.jit(opt.update)(p, g, st, 0.01)
+        assert new_p["w"].shape == p["w"].shape
+
+
+def test_lr_is_runtime_arg_no_recompile(rng):
+    """adjust_hyperp changes lr without retracing the train step."""
+    p, g = _params(rng), _grads(rng)
+    opt = momentum()
+    traces = 0
+
+    @jax.jit
+    def step(params, grads, st, lr):
+        nonlocal traces
+        traces += 1
+        return opt.update(params, grads, st, lr)
+
+    st = opt.init(p)
+    step(p, g, st, 0.1)
+    step(p, g, st, 0.01)
+    step(p, g, st, 0.001)
+    assert traces == 1

@@ -1,0 +1,92 @@
+"""Recorder + checkpoint unit tests (reference: lib/recorder.py,
+helper_funcs weight save/load)."""
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from theanompi_tpu.utils import (
+    Recorder,
+    latest_checkpoint,
+    load_checkpoint,
+    save_checkpoint,
+)
+
+
+class TestRecorder:
+    def test_segments(self):
+        rec = Recorder(verbose=False)
+        rec.start_epoch()
+        rec.start()
+        time.sleep(0.01)
+        rec.end("calc")
+        rec.start()
+        rec.end("wait")
+        assert rec.epoch_segments["calc"] >= 0.01
+        assert rec.epoch_segments["comm"] == 0.0
+
+    def test_train_window_and_save_load(self, tmp_path):
+        rec = Recorder(verbose=False)
+        for i in range(10):
+            rec.train_error(i, loss=1.0 / (i + 1), err=0.5)
+        rec.val_error(0.3, 0.1, 0.01)
+        rec.save(tmp_path / "rec.json")
+        rec2 = Recorder(verbose=False)
+        rec2.load(tmp_path / "rec.json")
+        assert rec2.n_iter == 10
+        assert rec2.train_losses == rec.train_losses
+        assert rec2.val_records == [{"loss": 0.3, "err": 0.1, "err_top5": 0.01}]
+
+    def test_bad_mode_asserts(self):
+        rec = Recorder(verbose=False)
+        rec.start()
+        with pytest.raises(AssertionError):
+            rec.end("compute")
+
+
+class TestCheckpoint:
+    def _trees(self):
+        return {
+            "params": {"w": jnp.arange(6.0).reshape(2, 3), "b": jnp.ones(3)},
+            "opt_state": {"m": {"w": jnp.zeros((2, 3)), "b": jnp.zeros(3)}},
+        }
+
+    def test_roundtrip(self, tmp_path):
+        trees = self._trees()
+        save_checkpoint(tmp_path, 5, trees, meta={"epoch": 5, "lr": 0.01})
+        path = latest_checkpoint(tmp_path)
+        assert path is not None and path.name == "ckpt_5.npz"
+        loaded, meta = load_checkpoint(path, trees)
+        assert meta == {"epoch": 5, "lr": 0.01}
+        np.testing.assert_array_equal(
+            np.asarray(loaded["params"]["w"]), np.arange(6.0).reshape(2, 3)
+        )
+
+    def test_latest_picks_highest_step(self, tmp_path):
+        trees = self._trees()
+        for step in (1, 10, 2):
+            save_checkpoint(tmp_path, step, trees)
+        assert latest_checkpoint(tmp_path).name == "ckpt_10.npz"
+
+    def test_shape_mismatch_raises(self, tmp_path):
+        trees = self._trees()
+        save_checkpoint(tmp_path, 0, trees)
+        bad = {"params": {"w": jnp.zeros((4, 4)), "b": jnp.ones(3)},
+               "opt_state": trees["opt_state"]}
+        with pytest.raises(ValueError, match="shape"):
+            load_checkpoint(latest_checkpoint(tmp_path), bad)
+
+    def test_missing_leaf_raises(self, tmp_path):
+        trees = self._trees()
+        save_checkpoint(tmp_path, 0, trees)
+        bigger = {
+            "params": {**trees["params"], "extra": jnp.zeros(2)},
+            "opt_state": trees["opt_state"],
+        }
+        with pytest.raises(KeyError):
+            load_checkpoint(latest_checkpoint(tmp_path), bigger)
+
+    def test_empty_dir_returns_none(self, tmp_path):
+        assert latest_checkpoint(tmp_path / "nope") is None

@@ -1,0 +1,9 @@
+"""Model zoo (reference: ``theanompi/models/`` — AlexNet, GoogLeNet,
+VGG16, ResNet-50, Wide-ResNet, Lasagne LSTM/IMDB).
+
+Every model satisfies the duck-typed contract the workers drive
+(reference README): ``build_model()``, ``compile_iter_fns()``,
+``train_iter(count, recorder)``, ``val_iter(count, recorder)``,
+``adjust_hyperp(epoch)``, and attributes ``params``, ``data``,
+``epoch``, ``n_epochs``.
+"""

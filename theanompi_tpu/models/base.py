@@ -1,0 +1,301 @@
+"""Model contract + generic SPMD classifier base.
+
+Reference contract (README-documented; SURVEY §1 L2): workers drive a
+duck-typed model exposing ``build_model / compile_iter_fns /
+train_iter / val_iter / adjust_hyperp / params / data / epoch /
+n_epochs``.  ``ClassifierModel`` implements the contract generically
+for image classifiers built on ``theanompi_tpu.ops``; concrete models
+(wresnet, alex_net, ...) subclass it and provide the network + config.
+
+The single biggest architectural difference from the reference
+(SURVEY §3.4): the train step is ONE jitted SPMD function —
+forward + backward + gradient allreduce + optimizer update — so the
+exchanger is *inside* the step and XLA overlaps the allreduce with
+backprop.  ``compile_iter_fns`` is the rebuild of the reference's
+``theano.function`` compilation, with the mesh and exchange strategy
+as arguments.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from theanompi_tpu.ops import optimizers as opt_lib
+from theanompi_tpu.ops.layers import accuracy, softmax_cross_entropy
+from theanompi_tpu.parallel import (
+    DATA_AXIS,
+    allreduce_mean,
+    get_strategy,
+    make_mesh,
+)
+from theanompi_tpu.utils import (
+    Recorder,
+    latest_checkpoint,
+    load_checkpoint,
+    save_checkpoint,
+)
+
+PyTree = Any
+
+
+class TMModel:
+    """Abstract contract; subclass or duck-type it.
+
+    ``build_model(n_replicas=...)`` receives the data-parallel replica
+    count so the model can size its data pipeline's global batch (the
+    reference sized per-GPU batches implicitly, one process per GPU).
+    """
+
+    params: PyTree
+    data: Any
+    epoch: int
+    n_epochs: int
+
+    def build_model(self, n_replicas: int = 1) -> None:
+        raise NotImplementedError
+
+    def compile_iter_fns(self, **kwargs) -> None:
+        raise NotImplementedError
+
+    def train_iter(self, count: int, recorder: Recorder) -> None:
+        raise NotImplementedError
+
+    def val_iter(self, count: int, recorder: Recorder):
+        raise NotImplementedError
+
+    def adjust_hyperp(self, epoch: int) -> None:
+        pass
+
+
+class ClassifierModel(TMModel):
+    """Generic SPMD image classifier satisfying the contract.
+
+    Subclasses set (in ``__init__`` or ``build_model``):
+    - ``self.net`` — a ``theanompi_tpu.ops.Layer`` ending in logits
+    - ``self.input_shape`` — per-example shape, e.g. ``(32, 32, 3)``
+    - ``self.data`` — data object (``n_batch_train``, ``n_batch_val``,
+      ``train_batch(i)``, ``val_batch(i)``, optional ``shuffle(epoch)``)
+    - ``self.optimizer`` — an ``ops.Optimizer`` (default momentum 0.9)
+
+    Config knobs follow the reference's per-model dicts (SURVEY §5.6):
+    ``batch_size`` (per replica), ``n_epochs``, ``lr``, ``lr_schedule``
+    (dict epoch→lr or 'step'), ``weight_decay``, ``exch_strategy``.
+    """
+
+    def __init__(self, config: dict | None = None):
+        self.config = dict(config or {})
+        self.n_epochs: int = self.config.get("n_epochs", 10)
+        self.epoch: int = 0
+        self.current_lr: float = self.config.get("lr", 0.1)
+        self.compute_dtype = jnp.dtype(
+            self.config.get("compute_dtype", "bfloat16")
+        )
+        self.seed = int(self.config.get("seed", 42))
+
+        self.net = None
+        self.data = None
+        self.input_shape: tuple = ()
+        self.optimizer = opt_lib.momentum(
+            mu=self.config.get("momentum", 0.9),
+            weight_decay=self.config.get("weight_decay", 1e-4),
+        )
+
+        self.params: PyTree = None
+        self.net_state: PyTree = None
+        self.opt_state: PyTree = None
+        self.mesh: Optional[Mesh] = None
+        self._train_step = None
+        self._val_step = None
+        self._rng = jax.random.PRNGKey(self.seed)
+
+    # -- construction ----------------------------------------------------
+
+    def build_model(self, n_replicas: int = 1) -> None:
+        """Define ``self.net``/``self.data`` and initialize params."""
+        raise NotImplementedError
+
+    def _init_params(self) -> None:
+        key = jax.random.PRNGKey(self.seed)
+        self.params, self.net_state, self._out_shape = self.net.init(
+            key, self.input_shape
+        )
+        self.opt_state = self.optimizer.init(self.params)
+
+    # -- compilation (reference: theano.function of fwd+bwd+update) -------
+
+    def compile_iter_fns(
+        self,
+        mesh: Mesh | None = None,
+        exch_strategy: str | None = None,
+    ) -> None:
+        if self.params is None:
+            self._init_params()
+        self.mesh = mesh if mesh is not None else make_mesh()
+        strat = get_strategy(
+            exch_strategy
+            or self.config.get("exch_strategy", "ici32")
+        )
+        net = self.net
+        optimizer = self.optimizer
+        cdtype = self.compute_dtype
+
+        def loss_fn(params, net_state, x, y, rng):
+            logits, new_state = net.apply(
+                params, net_state, x.astype(cdtype), train=True, rng=rng
+            )
+            loss = softmax_cross_entropy(logits, y)
+            err = 1.0 - accuracy(logits, y)
+            return loss, (new_state, err)
+
+        def shard_train(params, net_state, opt_state, x, y, lr, rng):
+            rng = jax.random.fold_in(rng, lax.axis_index(DATA_AXIS))
+            grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+            (loss, (new_state, err)), grads = grad_fn(
+                params, net_state, x, y, rng
+            )
+            # THE exchange: BSP allreduce folded into the step
+            # (reference: BSP_Exchanger.exchange between train iters).
+            grads = strat(grads, DATA_AXIS)
+            new_state = allreduce_mean(new_state, DATA_AXIS)
+            loss = lax.pmean(loss, DATA_AXIS)
+            err = lax.pmean(err, DATA_AXIS)
+            params, opt_state = optimizer.update(params, grads, opt_state, lr)
+            return params, new_state, opt_state, loss, err
+
+        def shard_val(params, net_state, x, y):
+            logits, _ = net.apply(
+                params, net_state, x.astype(cdtype), train=False
+            )
+            loss = lax.pmean(softmax_cross_entropy(logits, y), DATA_AXIS)
+            err = lax.pmean(1.0 - accuracy(logits, y), DATA_AXIS)
+            err5 = lax.pmean(1.0 - accuracy(logits, y, k=5), DATA_AXIS)
+            return loss, err, err5
+
+        rep = P()
+        dp = P(DATA_AXIS)
+        self._train_step = jax.jit(
+            jax.shard_map(
+                shard_train,
+                mesh=self.mesh,
+                in_specs=(rep, rep, rep, dp, dp, rep, rep),
+                out_specs=(rep, rep, rep, rep, rep),
+                check_vma=False,
+            ),
+            donate_argnums=(0, 1, 2),
+        )
+        self._val_step = jax.jit(
+            jax.shard_map(
+                shard_val,
+                mesh=self.mesh,
+                in_specs=(rep, rep, dp, dp),
+                out_specs=(rep, rep, rep),
+                check_vma=False,
+            )
+        )
+
+        # place params replicated on the mesh
+        rep_sharding = NamedSharding(self.mesh, P())
+        self.params, self.net_state, self.opt_state = jax.device_put(
+            (self.params, self.net_state, self.opt_state), rep_sharding
+        )
+        self._data_sharding = NamedSharding(self.mesh, P(DATA_AXIS))
+
+    # -- iteration fns (reference: model.train_iter / val_iter) -----------
+
+    def _put_batch(self, batch):
+        x, y = batch
+        return jax.device_put(jnp.asarray(x), self._data_sharding), \
+            jax.device_put(jnp.asarray(y), self._data_sharding)
+
+    def train_iter(self, count: int, recorder: Recorder) -> None:
+        recorder.start()
+        batch = self.data.train_batch(count)
+        x, y = self._put_batch(batch)
+        recorder.end("wait")
+
+        recorder.start()
+        self._rng, step_key = jax.random.split(self._rng)
+        (
+            self.params,
+            self.net_state,
+            self.opt_state,
+            loss,
+            err,
+        ) = self._train_step(
+            self.params,
+            self.net_state,
+            self.opt_state,
+            x,
+            y,
+            jnp.float32(self.current_lr),
+            step_key,
+        )
+        loss.block_until_ready()
+        recorder.end("calc")
+        recorder.train_error(count, float(loss), float(err))
+
+    def val_iter(self, count: int, recorder: Recorder):
+        batch = self.data.val_batch(count)
+        x, y = self._put_batch(batch)
+        loss, err, err5 = self._val_step(self.params, self.net_state, x, y)
+        return float(loss), float(err), float(err5)
+
+    # -- schedules (reference: adjust_hyperp per model) --------------------
+
+    def adjust_hyperp(self, epoch: int) -> None:
+        sched = self.config.get("lr_schedule")
+        if isinstance(sched, dict) and epoch in sched:
+            self.current_lr = float(sched[epoch])
+        elif sched == "step":
+            every = self.config.get("lr_step_every", 20)
+            gamma = self.config.get("lr_step_gamma", 0.1)
+            self.current_lr = self.config.get("lr", 0.1) * (
+                gamma ** (epoch // every)
+            )
+
+    # -- checkpoint / resume (reference: helper_funcs save/load) ----------
+
+    def checkpoint_trees(self) -> dict[str, PyTree]:
+        return {
+            "params": self.params,
+            "net_state": self.net_state,
+            "opt_state": self.opt_state,
+        }
+
+    def save(self, directory: str, recorder: Recorder | None = None) -> None:
+        meta = {"epoch": self.epoch, "lr": self.current_lr}
+        if recorder is not None:
+            meta["recorder"] = recorder.state_dict()
+        save_checkpoint(directory, self.epoch, self.checkpoint_trees(), meta)
+
+    def load(self, directory: str, recorder: Recorder | None = None) -> bool:
+        path = latest_checkpoint(directory)
+        if path is None:
+            return False
+        trees, meta = load_checkpoint(path, self.checkpoint_trees())
+        self.params = trees["params"]
+        self.net_state = trees["net_state"]
+        self.opt_state = trees["opt_state"]
+        self.epoch = int(meta.get("epoch", 0))
+        self.current_lr = float(meta.get("lr", self.current_lr))
+        if recorder is not None and "recorder" in meta:
+            rec = meta["recorder"]
+            recorder.train_losses = list(rec["train_losses"])
+            recorder.train_errors = list(rec["train_errors"])
+            recorder.val_records = list(rec["val_records"])
+            recorder.epoch_times = list(rec["epoch_times"])
+            recorder.n_iter = int(rec["n_iter"])
+        if self.mesh is not None:
+            rep = NamedSharding(self.mesh, P())
+            self.params, self.net_state, self.opt_state = jax.device_put(
+                (self.params, self.net_state, self.opt_state), rep
+            )
+        return True

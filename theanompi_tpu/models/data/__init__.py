@@ -1,0 +1,15 @@
+"""Input pipelines (reference: ``theanompi/models/data/`` —
+``imagenet.py``, ``cifar10.py``, ``imdb.py``, ``proc_load_mpi.py``).
+
+Data objects expose the protocol the models drive:
+``batch_size``, ``n_batch_train``, ``n_batch_val``,
+``train_batch(i) -> (x, y)``, ``val_batch(i) -> (x, y)``, and optional
+``shuffle(epoch)``.  Batches are global (per-replica batch x number of
+data-parallel replicas) numpy arrays; the model shards them onto the
+mesh.
+
+Because this environment has no network and may hold no datasets,
+every data object falls back to a *deterministic synthetic* dataset
+(class-separable, so convergence smoke tests are meaningful) when the
+real files are absent.  Set ``TM_DATA_DIR`` to point at real data.
+"""

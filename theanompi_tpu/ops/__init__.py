@@ -1,0 +1,56 @@
+"""Single-device compute library: layers, initializers, optimizers.
+
+TPU-native rebuild of the reference's hand-rolled layer library
+(reference: ``theanompi/models/layers2.py`` — ``Weight``, ``Conv``,
+``Pool``, ``LRN``, ``BN``, ``FC``, ``Dropout``, ``Softmax``) and its
+optimizer builders (reference: ``theanompi/lib/opt.py``).  Everything
+is a pure function over pytrees; layers carry an ``init``/``apply``
+pair instead of Theano shared variables, and compute runs in a
+configurable dtype (bf16 by default on TPU — MXU-native).
+"""
+
+from theanompi_tpu.ops import initializers
+from theanompi_tpu.ops.layers import (
+    Layer,
+    Sequential,
+    Conv,
+    Pool,
+    LRN,
+    BN,
+    FC,
+    Dropout,
+    Flatten,
+    GlobalAvgPool,
+    Activation,
+    softmax_cross_entropy,
+    accuracy,
+)
+from theanompi_tpu.ops.optimizers import (
+    sgd,
+    momentum,
+    nesterov,
+    adam,
+    Optimizer,
+)
+
+__all__ = [
+    "initializers",
+    "Layer",
+    "Sequential",
+    "Conv",
+    "Pool",
+    "LRN",
+    "BN",
+    "FC",
+    "Dropout",
+    "Flatten",
+    "GlobalAvgPool",
+    "Activation",
+    "softmax_cross_entropy",
+    "accuracy",
+    "sgd",
+    "momentum",
+    "nesterov",
+    "adam",
+    "Optimizer",
+]

@@ -1,0 +1,95 @@
+"""Weight initialization schemes.
+
+Reference: ``theanompi/models/layers2.py`` — the ``Weight`` class
+offered normal / uniform / xavier (glorot) / he ("kaiming") init plus
+save/load of individual arrays.  Here each scheme is a pure function
+``(key, shape, dtype) -> jnp.ndarray``; persistence is handled by the
+checkpoint subsystem (``theanompi_tpu.utils.checkpoint``) instead of
+per-array files.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def _fans(shape) -> tuple[int, int]:
+    """(fan_in, fan_out) for FC [in, out] and conv [H, W, I, O] shapes."""
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    receptive = math.prod(shape[:-2])
+    return shape[-2] * receptive, shape[-1] * receptive
+
+
+def constant(value: float):
+    def init(key, shape, dtype=jnp.float32):
+        return jnp.full(shape, value, dtype)
+
+    return init
+
+
+zeros = constant(0.0)
+ones = constant(1.0)
+
+
+def normal(std: float = 0.01, mean: float = 0.0):
+    def init(key, shape, dtype=jnp.float32):
+        return mean + std * jax.random.normal(key, shape, dtype)
+
+    return init
+
+
+def uniform(scale: float = 0.05):
+    def init(key, shape, dtype=jnp.float32):
+        return jax.random.uniform(key, shape, dtype, -scale, scale)
+
+    return init
+
+
+def xavier(gain: float = 1.0):
+    """Glorot uniform: U(±gain * sqrt(6 / (fan_in + fan_out)))."""
+
+    def init(key, shape, dtype=jnp.float32):
+        fan_in, fan_out = _fans(shape)
+        limit = gain * math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -limit, limit)
+
+    return init
+
+
+def he(gain: float = 2.0):
+    """He/Kaiming normal: N(0, sqrt(gain / fan_in)) — for ReLU nets."""
+
+    def init(key, shape, dtype=jnp.float32):
+        fan_in, _ = _fans(shape)
+        return jax.random.normal(key, shape, dtype) * math.sqrt(gain / fan_in)
+
+    return init
+
+
+def get(spec):
+    """Resolve an initializer spec: callable | name | (name, kwargs)."""
+    if callable(spec):
+        return spec
+    if isinstance(spec, str):
+        return {
+            "zeros": zeros,
+            "ones": ones,
+            "normal": normal(),
+            "uniform": uniform(),
+            "xavier": xavier(),
+            "he": he(),
+        }[spec]
+    name, kwargs = spec
+    return {
+        "constant": constant,
+        "normal": normal,
+        "uniform": uniform,
+        "xavier": xavier,
+        "he": he,
+    }[name](**kwargs)

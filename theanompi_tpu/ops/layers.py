@@ -1,0 +1,345 @@
+"""Functional layer library (the reference's ``layers2.py``, TPU-first).
+
+Reference: ``theanompi/models/layers2.py`` — class-based layers holding
+Theano shared variables (``Conv`` via cuDNN ``dnn_conv``, ``Pool``,
+``LRN``, ``BN``, ``FC``, ``Dropout``, ``Softmax``).  Rebuilt as
+init/apply pairs over pytrees:
+
+- ``layer.init(key, in_shape)`` → ``(params, state, out_shape)``
+- ``layer.apply(params, state, x, train=..., rng=...)`` → ``(y, state)``
+
+TPU-first choices: NHWC layout (XLA:TPU's preferred conv layout),
+fp32 master params with a configurable ``compute_dtype`` (bf16 feeds
+the MXU at full rate), ``lax.conv_general_dilated`` /
+``lax.reduce_window`` so XLA tiles everything onto the systolic array.
+``state`` carries BN running statistics (the reference kept them as
+extra shared variables).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from theanompi_tpu.ops import initializers
+
+PyTree = Any
+
+
+def _split(key, n):
+    return jax.random.split(key, n) if n > 1 else [key]
+
+
+class Layer:
+    """Base layer: stateless module descriptor; params live in pytrees."""
+
+    name: str = "layer"
+
+    def init(self, key, in_shape):
+        """→ (params, state, out_shape).  Shapes exclude the batch dim."""
+        return {}, {}, in_shape
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}()"
+
+
+class Activation(Layer):
+    """Elementwise nonlinearity (relu/tanh/...); fused into neighbors by XLA."""
+
+    def __init__(self, fn: Callable | str = "relu"):
+        self.fn = getattr(jax.nn, fn) if isinstance(fn, str) else fn
+
+    def init(self, key, in_shape):
+        return {}, {}, in_shape
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        return self.fn(x), state
+
+
+class Conv(Layer):
+    """2-D convolution, NHWC / HWIO (reference: cuDNN ``dnn_conv``).
+
+    ``pad`` is 'SAME', 'VALID', or an int of symmetric padding.
+    """
+
+    def __init__(
+        self,
+        out_ch: int,
+        kernel: int | tuple[int, int],
+        stride: int | tuple[int, int] = 1,
+        pad: str | int = "SAME",
+        *,
+        w_init=initializers.he(),
+        b_init=initializers.zeros,
+        bias: bool = True,
+        groups: int = 1,
+    ):
+        self.out_ch = out_ch
+        self.kernel = (kernel, kernel) if isinstance(kernel, int) else kernel
+        self.stride = (stride, stride) if isinstance(stride, int) else stride
+        self.pad = pad
+        self.w_init = initializers.get(w_init)
+        self.b_init = initializers.get(b_init)
+        self.bias = bias
+        self.groups = groups
+
+    def init(self, key, in_shape):
+        h, w, c = in_shape
+        kh, kw = self.kernel
+        wkey, bkey = _split(key, 2)
+        params = {
+            "w": self.w_init(wkey, (kh, kw, c // self.groups, self.out_ch))
+        }
+        if self.bias:
+            params["b"] = self.b_init(bkey, (self.out_ch,))
+        pad = self.pad
+        if isinstance(pad, int):
+            out_h = (h + 2 * pad - kh) // self.stride[0] + 1
+            out_w = (w + 2 * pad - kw) // self.stride[1] + 1
+        elif pad == "SAME":
+            out_h = -(-h // self.stride[0])
+            out_w = -(-w // self.stride[1])
+        else:  # VALID
+            out_h = (h - kh) // self.stride[0] + 1
+            out_w = (w - kw) // self.stride[1] + 1
+        return params, {}, (out_h, out_w, self.out_ch)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        pad = self.pad
+        if isinstance(pad, int):
+            pad = [(pad, pad), (pad, pad)]
+        y = lax.conv_general_dilated(
+            x,
+            params["w"].astype(x.dtype),
+            window_strides=self.stride,
+            padding=pad,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=self.groups,
+        )
+        if self.bias:
+            y = y + params["b"].astype(y.dtype)
+        return y, state
+
+
+class Pool(Layer):
+    """Max/avg pooling via ``lax.reduce_window`` (reference: ``Pool``)."""
+
+    def __init__(
+        self,
+        size: int | tuple[int, int] = 2,
+        stride: int | tuple[int, int] | None = None,
+        mode: str = "max",
+        pad: str = "VALID",
+    ):
+        self.size = (size, size) if isinstance(size, int) else size
+        stride = stride if stride is not None else size
+        self.stride = (stride, stride) if isinstance(stride, int) else stride
+        assert mode in ("max", "avg")
+        self.mode = mode
+        self.pad = pad
+
+    def init(self, key, in_shape):
+        h, w, c = in_shape
+        if self.pad == "SAME":
+            out_h = -(-h // self.stride[0])
+            out_w = -(-w // self.stride[1])
+        else:
+            out_h = (h - self.size[0]) // self.stride[0] + 1
+            out_w = (w - self.size[1]) // self.stride[1] + 1
+        return {}, {}, (out_h, out_w, c)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        dims = (1, *self.size, 1)
+        strides = (1, *self.stride, 1)
+        if self.mode == "max":
+            y = lax.reduce_window(
+                x, -jnp.inf, lax.max, dims, strides, self.pad
+            )
+        else:
+            summed = lax.reduce_window(
+                x, 0.0, lax.add, dims, strides, self.pad
+            )
+            y = summed / (self.size[0] * self.size[1])
+        return y, state
+
+
+class LRN(Layer):
+    """Local response normalization across channels (AlexNet-era).
+
+    Reference: ``layers2.LRN`` (cuDNN LRN).  y = x / (k + a/n * sum x^2)^b
+    over a window of ``n`` adjacent channels.
+    """
+
+    def __init__(self, n: int = 5, k: float = 2.0, alpha: float = 1e-4, beta: float = 0.75):
+        self.n, self.k, self.alpha, self.beta = n, k, alpha, beta
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        half = self.n // 2
+        sq = jnp.square(x.astype(jnp.float32))
+        # channel window sum via padded cumulative trick: pad C then slide.
+        padded = jnp.pad(sq, [(0, 0)] * (x.ndim - 1) + [(half, half)])
+        win = sum(
+            lax.dynamic_slice_in_dim(padded, i, x.shape[-1], axis=x.ndim - 1)
+            for i in range(self.n)
+        )
+        denom = jnp.power(self.k + (self.alpha / self.n) * win, self.beta)
+        return (x.astype(jnp.float32) / denom).astype(x.dtype), state
+
+
+class BN(Layer):
+    """Batch normalization with running statistics (reference: ``BN``).
+
+    Running mean/var live in ``state`` (the reference used extra shared
+    variables updated inside the Theano function).
+    """
+
+    def __init__(self, momentum: float = 0.9, eps: float = 1e-5, axis=None):
+        self.momentum = momentum
+        self.eps = eps
+        self.axis = axis  # axes to reduce over; default: all but channel
+
+    def init(self, key, in_shape):
+        c = in_shape[-1]
+        params = {"scale": jnp.ones((c,)), "offset": jnp.zeros((c,))}
+        state = {"mean": jnp.zeros((c,)), "var": jnp.ones((c,))}
+        return params, state, in_shape
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        axes = self.axis if self.axis is not None else tuple(range(x.ndim - 1))
+        xf = x.astype(jnp.float32)
+        if train:
+            mean = jnp.mean(xf, axes)
+            var = jnp.var(xf, axes)
+            m = self.momentum
+            state = {
+                "mean": m * state["mean"] + (1 - m) * mean,
+                "var": m * state["var"] + (1 - m) * var,
+            }
+        else:
+            mean, var = state["mean"], state["var"]
+        y = (xf - mean) * lax.rsqrt(var + self.eps)
+        y = y * params["scale"] + params["offset"]
+        return y.astype(x.dtype), state
+
+
+class FC(Layer):
+    """Fully connected layer (reference: ``FC``) — one MXU matmul."""
+
+    def __init__(
+        self,
+        out_dim: int,
+        *,
+        w_init=initializers.he(),
+        b_init=initializers.zeros,
+        bias: bool = True,
+    ):
+        self.out_dim = out_dim
+        self.w_init = initializers.get(w_init)
+        self.b_init = initializers.get(b_init)
+        self.bias = bias
+
+    def init(self, key, in_shape):
+        (d,) = in_shape
+        wkey, bkey = _split(key, 2)
+        params = {"w": self.w_init(wkey, (d, self.out_dim))}
+        if self.bias:
+            params["b"] = self.b_init(bkey, (self.out_dim,))
+        return params, {}, (self.out_dim,)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        y = x @ params["w"].astype(x.dtype)
+        if self.bias:
+            y = y + params["b"].astype(y.dtype)
+        return y, state
+
+
+class Dropout(Layer):
+    """Inverted dropout (reference: ``Dropout``); identity at eval."""
+
+    def __init__(self, rate: float = 0.5):
+        self.rate = rate
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        if not train or self.rate == 0.0:
+            return x, state
+        if rng is None:
+            raise ValueError("Dropout needs rng when train=True")
+        keep = 1.0 - self.rate
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, 0).astype(x.dtype), state
+
+
+class GlobalAvgPool(Layer):
+    """Spatial global average pool: NHWC -> NC."""
+
+    def init(self, key, in_shape):
+        return {}, {}, (in_shape[-1],)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        return jnp.mean(x, axis=(1, 2)), state
+
+
+class Flatten(Layer):
+    def init(self, key, in_shape):
+        return {}, {}, (math.prod(in_shape),)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        return x.reshape(x.shape[0], -1), state
+
+
+class Sequential(Layer):
+    """Layer composition with shape inference (reference composed layers
+    manually in each model's ``build_model``)."""
+
+    def __init__(self, layers: Sequence[Layer]):
+        self.layers = list(layers)
+
+    def init(self, key, in_shape):
+        keys = jax.random.split(key, max(len(self.layers), 1))
+        params, state = [], []
+        shape = in_shape
+        for k, layer in zip(keys, self.layers):
+            p, s, shape = layer.init(k, shape)
+            params.append(p)
+            state.append(s)
+        return params, state, shape
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        rngs = (
+            jax.random.split(rng, max(len(self.layers), 1))
+            if rng is not None
+            else [None] * len(self.layers)
+        )
+        new_state = []
+        for layer, p, s, r in zip(self.layers, params, state, rngs):
+            x, s = layer.apply(p, s, x, train=train, rng=r)
+            new_state.append(s)
+        return x, new_state
+
+
+# ---------------------------------------------------------------------------
+# Losses / metrics (reference: Softmax layer + negative_log_likelihood
+# + errors() inside layers2/models)
+# ---------------------------------------------------------------------------
+
+def softmax_cross_entropy(logits, labels) -> jnp.ndarray:
+    """Mean softmax cross-entropy; labels are int class ids."""
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits)
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+    return jnp.mean(nll)
+
+
+def accuracy(logits, labels, k: int = 1) -> jnp.ndarray:
+    """Top-k accuracy (reference reported top-1/top-5 errors)."""
+    if k == 1:
+        return jnp.mean(jnp.argmax(logits, -1) == labels)
+    topk = jax.lax.top_k(logits, k)[1]
+    return jnp.mean(jnp.any(topk == labels[:, None], axis=-1))

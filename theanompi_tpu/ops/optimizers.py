@@ -1,0 +1,147 @@
+"""Optimizer update builders (reference: ``theanompi/lib/opt.py``).
+
+The reference built Theano update pairs for vanilla SGD, classical
+momentum and Nesterov momentum (with weight decay), compiled into the
+train function.  Here each optimizer is an ``Optimizer`` with pure
+``init``/``update`` functions folded into the jitted train step — the
+same shape as optax (which interoperates: any optax GradientTransform
+can be wrapped), but self-contained and with the reference's exact
+hyperparameter knobs, including a mutable learning rate passed *as an
+argument* so ``adjust_hyperp`` (lr schedules) never triggers a
+recompile.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+PyTree = Any
+
+
+@dataclass(frozen=True)
+class Optimizer:
+    """Pair of pure fns; ``lr`` is a runtime argument, not baked in."""
+
+    init: Callable[[PyTree], PyTree]
+    update: Callable[..., tuple[PyTree, PyTree]]
+    # update(params, grads, opt_state, lr) -> (new_params, new_opt_state)
+
+
+def _tree_zeros_like(tree):
+    return jax.tree.map(jnp.zeros_like, tree)
+
+
+def sgd(weight_decay: float = 0.0) -> Optimizer:
+    """Vanilla SGD: p -= lr * (g + wd*p)."""
+
+    def init(params):
+        return ()
+
+    def update(params, grads, opt_state, lr):
+        def one(p, g):
+            g = g + weight_decay * p if weight_decay else g
+            return (p - lr * g).astype(p.dtype)
+
+        return jax.tree.map(one, params, grads), opt_state
+
+    return Optimizer(init, update)
+
+
+def momentum(mu: float = 0.9, weight_decay: float = 0.0) -> Optimizer:
+    """Classical momentum (the reference's default for AlexNet:
+    mu=0.9, wd=5e-4): v = mu*v - lr*g; p += v."""
+
+    def init(params):
+        return _tree_zeros_like(params)
+
+    def update(params, grads, velocity, lr):
+        def upd_v(p, g, v):
+            g = g + weight_decay * p if weight_decay else g
+            return mu * v - lr * g
+
+        v_new = jax.tree.map(upd_v, params, grads, velocity)
+        new_params = jax.tree.map(
+            lambda p, v: (p + v).astype(p.dtype), params, v_new
+        )
+        return new_params, v_new
+
+    return Optimizer(init, update)
+
+
+def nesterov(mu: float = 0.9, weight_decay: float = 0.0) -> Optimizer:
+    """Nesterov momentum: v = mu*v - lr*g; p += mu*v - lr*g."""
+
+    def init(params):
+        return _tree_zeros_like(params)
+
+    def update(params, grads, velocity, lr):
+        def upd_v(p, g, v):
+            g = g + weight_decay * p if weight_decay else g
+            return mu * v - lr * g
+
+        v_new = jax.tree.map(upd_v, params, grads, velocity)
+
+        def upd_p(p, g, v):
+            g = g + weight_decay * p if weight_decay else g
+            return (p + mu * v - lr * g).astype(p.dtype)
+
+        new_params = jax.tree.map(upd_p, params, grads, v_new)
+        return new_params, v_new
+
+    return Optimizer(init, update)
+
+
+def adam(
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+) -> Optimizer:
+    """Adam (new-framework scope — needed by the LSTM and Llama configs;
+    the reference's Lasagne zoo pulled adam from Lasagne)."""
+
+    def init(params):
+        return {
+            "m": _tree_zeros_like(params),
+            "v": _tree_zeros_like(params),
+            "t": jnp.zeros((), jnp.int32),
+        }
+
+    def update(params, grads, opt_state, lr):
+        t = opt_state["t"] + 1
+        tf = t.astype(jnp.float32)
+        bias1 = 1 - b1**tf
+        bias2 = 1 - b2**tf
+
+        def upd_m(m, g):
+            return b1 * m + (1 - b1) * g
+
+        def upd_v(v, g):
+            return b2 * v + (1 - b2) * jnp.square(g)
+
+        m = jax.tree.map(upd_m, opt_state["m"], grads)
+        v = jax.tree.map(upd_v, opt_state["v"], grads)
+
+        def one(p, m_, v_):
+            step = lr * (m_ / bias1) / (jnp.sqrt(v_ / bias2) + eps)
+            if weight_decay:
+                step = step + lr * weight_decay * p
+            return (p - step).astype(p.dtype)
+
+        new_params = jax.tree.map(one, params, m, v)
+        return new_params, {"m": m, "v": v, "t": t}
+
+    return Optimizer(init, update)
+
+
+def get(name: str, **kwargs) -> Optimizer:
+    return {
+        "sgd": sgd,
+        "momentum": momentum,
+        "nesterov": nesterov,
+        "adam": adam,
+    }[name](**kwargs)

@@ -1,0 +1,9 @@
+"""Support subsystems: metrics recorder, checkpointing, helpers.
+
+TPU-native rebuild of ``theanompi/lib/{recorder,helper_funcs}.py``.
+"""
+
+from theanompi_tpu.utils.recorder import Recorder
+from theanompi_tpu.utils.checkpoint import save_checkpoint, load_checkpoint, latest_checkpoint
+
+__all__ = ["Recorder", "save_checkpoint", "load_checkpoint", "latest_checkpoint"]

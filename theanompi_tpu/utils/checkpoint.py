@@ -1,0 +1,112 @@
+"""Checkpoint / resume.
+
+Reference: per-epoch weight save as per-param ``.npy``/pickle files via
+``theanompi/lib/helper_funcs.py`` helpers, rank 0 writing; resume
+restores weights + epoch + lr-schedule position (SURVEY §5.4).
+
+Rebuild: one ``.npz`` per checkpoint holding every leaf of the
+(params, state, opt_state) pytrees keyed by its tree path, plus a JSON
+sidecar with scalar metadata (epoch, lr, recorder state).  Works for
+any pytree the models produce, is single-file-per-step (atomic rename)
+and host-portable.  Orbax remains available for sharded multi-host
+checkpoints; this module is the dependency-free core path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from pathlib import Path
+from typing import Any
+
+import jax
+import numpy as np
+
+PyTree = Any
+
+
+def _leaf_names(tree) -> list[str]:
+    paths = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return [jax.tree_util.keystr(p) for p, _ in paths]
+
+
+def tree_to_dict(tree: PyTree) -> dict[str, np.ndarray]:
+    paths = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return {jax.tree_util.keystr(p): np.asarray(v) for p, v in paths}
+
+
+def dict_to_tree(d: dict[str, np.ndarray], like: PyTree) -> PyTree:
+    paths, treedef = jax.tree_util.tree_flatten_with_path(like)
+    leaves = []
+    for p, old in paths:
+        k = jax.tree_util.keystr(p)
+        if k not in d:
+            raise KeyError(f"checkpoint missing leaf {k!r}")
+        arr = d[k]
+        if tuple(arr.shape) != tuple(np.shape(old)):
+            raise ValueError(
+                f"checkpoint leaf {k!r} has shape {arr.shape}, expected "
+                f"{np.shape(old)}"
+            )
+        leaves.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def save_checkpoint(
+    directory: str | Path,
+    step: int,
+    trees: dict[str, PyTree],
+    meta: dict | None = None,
+) -> Path:
+    """Write ``{directory}/ckpt_{step}.npz`` (+ ``.json`` metadata)."""
+    directory = Path(directory)
+    directory.mkdir(parents=True, exist_ok=True)
+    flat: dict[str, np.ndarray] = {}
+    for group, tree in trees.items():
+        for k, v in tree_to_dict(tree).items():
+            flat[f"{group}:{k}"] = v
+    # meta lands before the npz is renamed into place: a crash in
+    # between leaves stray files but never a discoverable checkpoint
+    # with missing metadata (which would silently resume at epoch 0).
+    if meta is not None:
+        (directory / f"ckpt_{step}.json").write_text(json.dumps(meta))
+    tmp = directory / f".ckpt_{step}.npz.tmp"
+    final = directory / f"ckpt_{step}.npz"
+    with open(tmp, "wb") as f:
+        np.savez(f, **flat)
+    os.replace(tmp, final)
+    return final
+
+
+def load_checkpoint(
+    path: str | Path,
+    like: dict[str, PyTree],
+) -> tuple[dict[str, PyTree], dict]:
+    """Load trees (validated against ``like`` structure) + metadata."""
+    path = Path(path)
+    with np.load(path) as z:
+        flat = {k: z[k] for k in z.files}
+    out = {}
+    for group, tree in like.items():
+        sub = {
+            k[len(group) + 1:]: v
+            for k, v in flat.items()
+            if k.startswith(group + ":")
+        }
+        out[group] = dict_to_tree(sub, tree)
+    meta_path = path.with_suffix(".json")
+    meta = json.loads(meta_path.read_text()) if meta_path.exists() else {}
+    return out, meta
+
+
+def latest_checkpoint(directory: str | Path) -> Path | None:
+    directory = Path(directory)
+    if not directory.is_dir():
+        return None
+    best, best_step = None, -1
+    for p in directory.glob("ckpt_*.npz"):
+        m = re.fullmatch(r"ckpt_(\d+)\.npz", p.name)
+        if m and int(m.group(1)) > best_step:
+            best, best_step = p, int(m.group(1))
+    return best

@@ -1,0 +1,161 @@
+"""Training metrics / wall-clock recorder.
+
+Reference: ``theanompi/lib/recorder.py`` — per-iteration wall-clock
+segments (≈ ``calc``/``comm``/``wait``), rolling train info every N
+batches, epoch summaries, and persisted record arrays for resume +
+offline plotting (the paper's calc-vs-comm breakdowns came from it).
+
+TPU caveat (SURVEY §5.1): XLA overlaps the gradient allreduce with
+backprop inside one jitted step, so an honest ``comm`` segment cannot
+be measured by fencing two host calls the way the reference did.  The
+recorder therefore reports:
+
+- ``calc`` — time blocked in the train step (device-fenced via
+  ``block_until_ready`` when ``fence=True``),
+- ``comm`` — host-driven exchange time (nonzero only for the async
+  rules, whose elastic/gossip exchanges are separate dispatches),
+- ``wait`` — input-pipeline stalls (waiting on the next batch).
+
+For intra-step comm attribution use ``jax.profiler`` traces
+(``Recorder.start_profiler``/``stop_profiler``).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+MODES = ("calc", "comm", "wait")
+
+
+class Recorder:
+    def __init__(
+        self,
+        rank: int = 0,
+        size: int = 1,
+        print_freq: int = 40,
+        verbose: bool = True,
+    ):
+        self.rank = rank
+        self.size = size
+        self.print_freq = print_freq
+        self.verbose = verbose and rank == 0
+
+        self._t0: Optional[float] = None
+        self.segments = {m: 0.0 for m in MODES}   # current-iteration
+        self.epoch_segments = {m: 0.0 for m in MODES}
+
+        self.train_losses: list[float] = []
+        self.train_errors: list[float] = []
+        self.val_records: list[dict] = []          # per epoch
+        self.epoch_times: list[float] = []
+        self._epoch_start: Optional[float] = None
+        self._window: list[tuple[float, float]] = []  # (loss, err) since last print
+        self.n_iter = 0
+
+    # -- wall-clock segments (reference: start()/end(mode)) ---------------
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def end(self, mode: str) -> None:
+        assert mode in MODES, mode
+        if self._t0 is None:
+            return
+        dt = time.perf_counter() - self._t0
+        self.segments[mode] += dt
+        self.epoch_segments[mode] += dt
+        self._t0 = None
+
+    # -- train/val bookkeeping -------------------------------------------
+
+    def start_epoch(self) -> None:
+        self._epoch_start = time.perf_counter()
+        self.epoch_segments = {m: 0.0 for m in MODES}
+
+    def train_error(self, count: int, loss: float, err: float) -> None:
+        self.train_losses.append(float(loss))
+        self.train_errors.append(float(err))
+        self._window.append((float(loss), float(err)))
+        self.n_iter += 1
+
+    def print_train_info(self, count: int) -> None:
+        if not self.verbose or count == 0 or count % self.print_freq:
+            return
+        if not self._window:
+            return
+        losses, errs = zip(*self._window)
+        seg = self.segments
+        print(
+            f"iter {count}: loss {np.mean(losses):.4f} err {np.mean(errs):.4f}"
+            f" | calc {seg['calc']:.3f}s comm {seg['comm']:.3f}s"
+            f" wait {seg['wait']:.3f}s",
+            flush=True,
+        )
+        self._window = []
+        self.segments = {m: 0.0 for m in MODES}
+
+    def val_error(self, loss: float, err: float, err_top5: float | None = None) -> None:
+        rec = {"loss": float(loss), "err": float(err)}
+        if err_top5 is not None:
+            rec["err_top5"] = float(err_top5)
+        self.val_records.append(rec)
+
+    def end_epoch(self, epoch: int) -> None:
+        if self._epoch_start is None:
+            return
+        wall = time.perf_counter() - self._epoch_start
+        self.epoch_times.append(wall)
+        if self.verbose:
+            seg = self.epoch_segments
+            val = self.val_records[-1] if self.val_records else {}
+            val_str = (
+                f" | val loss {val.get('loss', float('nan')):.4f}"
+                f" err {val.get('err', float('nan')):.4f}"
+                if val
+                else ""
+            )
+            print(
+                f"epoch {epoch}: {wall:.1f}s"
+                f" (calc {seg['calc']:.1f}s comm {seg['comm']:.1f}s"
+                f" wait {seg['wait']:.1f}s){val_str}",
+                flush=True,
+            )
+
+    # -- profiler handoff (SURVEY §5.1 rebuild note) ----------------------
+
+    def start_profiler(self, logdir: str) -> None:
+        import jax
+
+        jax.profiler.start_trace(logdir)
+
+    def stop_profiler(self) -> None:
+        import jax
+
+        jax.profiler.stop_trace()
+
+    # -- persistence (reference: save()/load() of record arrays) ----------
+
+    def state_dict(self) -> dict:
+        return {
+            "train_losses": self.train_losses,
+            "train_errors": self.train_errors,
+            "val_records": self.val_records,
+            "epoch_times": self.epoch_times,
+            "n_iter": self.n_iter,
+        }
+
+    def save(self, path: str | Path) -> None:
+        Path(path).write_text(json.dumps(self.state_dict()))
+
+    def load(self, path: str | Path) -> None:
+        d = json.loads(Path(path).read_text())
+        self.train_losses = list(d["train_losses"])
+        self.train_errors = list(d["train_errors"])
+        self.val_records = list(d["val_records"])
+        self.epoch_times = list(d["epoch_times"])
+        self.n_iter = int(d["n_iter"])

@@ -1,0 +1,122 @@
+"""BSP worker: synchronous data-parallel training loop.
+
+Reference: ``theanompi/bsp_worker.py`` — ``BSP_Worker``: per-process
+loop of ``train_iter`` → ``exchanger.exchange`` every iteration →
+periodic validation → lr schedule → checkpoint (SURVEY §3.1).
+
+TPU-native shape: ONE controller process drives all chips through a
+``Mesh``; the exchange lives *inside* the jitted train step (gradient
+allreduce), so the loop body is just ``model.train_iter`` — XLA
+overlaps the collective with backprop, which the reference could not.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any, Sequence
+
+from theanompi_tpu import launcher as _launcher
+from theanompi_tpu.parallel import make_mesh, default_devices
+from theanompi_tpu.utils import Recorder
+
+
+def _resolve_model(modelfile: str, modelclass: str):
+    mod = importlib.import_module(modelfile)
+    return getattr(mod, modelclass)
+
+
+def _build_mesh(devices: Sequence[Any] | None):
+    devs = default_devices()
+    if devices is not None:
+        n = len(devices)
+        if n > len(devs):
+            raise ValueError(f"requested {n} devices, have {len(devs)}")
+        devs = devs[:n]
+    return make_mesh(data=len(devs), devices=devs)
+
+
+def run(
+    devices: Sequence[Any] | None = None,
+    modelfile: str = "",
+    modelclass: str = "",
+    *,
+    config: dict | None = None,
+    exch_strategy: str | None = None,
+    n_epochs: int | None = None,
+    checkpoint_dir: str | None = None,
+    resume: bool = False,
+    print_freq: int = 40,
+    verbose: bool = True,
+    **extra: Any,
+) -> dict:
+    """Train ``modelclass`` under BSP; returns a summary dict."""
+    mesh = _build_mesh(devices)
+    n_replicas = mesh.shape["data"]
+
+    Model = _resolve_model(modelfile, modelclass)
+    cfg = dict(config or {})
+    cfg.update(extra)
+    if n_epochs is not None:
+        cfg["n_epochs"] = n_epochs
+    model = Model(cfg)
+    model.build_model(n_replicas=n_replicas)
+    model.compile_iter_fns(mesh=mesh, exch_strategy=exch_strategy)
+
+    recorder = Recorder(
+        rank=0, size=n_replicas, print_freq=print_freq, verbose=verbose
+    )
+    if resume and checkpoint_dir:
+        if model.load(checkpoint_dir, recorder):
+            model.epoch += 1  # saved after finishing that epoch
+            if verbose:
+                print(f"resumed from epoch {model.epoch - 1}", flush=True)
+
+    data = model.data
+    if verbose:
+        print(
+            f"BSP: {n_replicas} replicas, {data.n_batch_train} train batches"
+            f" x {data.global_batch} global batch",
+            flush=True,
+        )
+
+    while model.epoch < model.n_epochs:
+        epoch = model.epoch
+        recorder.start_epoch()
+        if hasattr(data, "shuffle"):
+            data.shuffle(epoch)
+        for i in range(data.n_batch_train):
+            model.train_iter(i, recorder)
+            recorder.print_train_info(i)
+
+        if data.n_batch_val:
+            tot_l = tot_e = tot_e5 = 0.0
+            for j in range(data.n_batch_val):
+                l, e, e5 = model.val_iter(j, recorder)
+                tot_l += l
+                tot_e += e
+                tot_e5 += e5
+            nv = data.n_batch_val
+            recorder.val_error(tot_l / nv, tot_e / nv, tot_e5 / nv)
+
+        recorder.end_epoch(epoch)
+        model.adjust_hyperp(epoch + 1)
+        if checkpoint_dir:
+            model.save(checkpoint_dir, recorder)
+        model.epoch += 1
+
+    last_val = recorder.val_records[-1] if recorder.val_records else {}
+    return {
+        "epochs": model.epoch,
+        "iterations": recorder.n_iter,
+        "final_train_loss": (
+            recorder.train_losses[-1] if recorder.train_losses else None
+        ),
+        "final_val": last_val,
+        "epoch_times": recorder.epoch_times,
+        "recorder": recorder,
+        "model": model,
+    }
+
+
+if __name__ == "__main__":
+    _launcher.worker_main(run)
