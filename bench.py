@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Benchmark entry: prints ONE JSON line with the headline metric.
+
+Metric (BASELINE.json): ResNet-50 images/sec/chip under the BSP rule.
+Falls back to the largest model available if ResNet-50 isn't built yet.
+
+``vs_baseline`` compares against ``BENCH_BASELINE.json`` (this repo's
+recorded first-measurement / reference number); 1.0 means parity with
+that record.  BASELINE.json.published is empty (reference mount was
+empty — see SURVEY.md §0), so the recorded first TPU measurement is
+the working baseline until real reference numbers exist.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+REPO = Path(__file__).resolve().parent
+
+FLAGSHIP_CANDIDATES = [
+    # (modelfile, modelclass, config, per-chip batch)
+    (
+        "theanompi_tpu.models.resnet50",
+        "ResNet50",
+        {"batch_size": 128, "compute_dtype": "bfloat16"},
+        128,
+    ),
+    (
+        "theanompi_tpu.models.wresnet",
+        "WResNet",
+        {"batch_size": 256, "depth": 28, "widen": 10,
+         "compute_dtype": "bfloat16"},
+        256,
+    ),
+]
+
+
+def _load_flagship():
+    import importlib
+
+    for modelfile, modelclass, cfg, batch in FLAGSHIP_CANDIDATES:
+        try:
+            mod = importlib.import_module(modelfile)
+        except ImportError:
+            continue
+        cls = getattr(mod, modelclass, None)
+        if cls is not None:
+            return modelfile, modelclass, cls, cfg, batch
+    raise RuntimeError("no flagship model importable")
+
+
+def main() -> None:
+    from theanompi_tpu.parallel import make_mesh, default_devices
+
+    devices = default_devices()
+    n_chips = len(devices)
+    mesh = make_mesh(data=n_chips, devices=devices)
+
+    modelfile, modelclass, cls, cfg, batch = _load_flagship()
+    cfg = dict(cfg)
+    cfg["n_train"] = max(4 * batch * n_chips, 2048)
+    cfg["n_val"] = batch * n_chips
+    model = cls(cfg)
+    model.build_model(n_replicas=n_chips)
+    model.compile_iter_fns(mesh=mesh, exch_strategy="ici32")
+
+    x, y = model.data.train_batch(0)
+    xd, yd = model.put_batch((x, y))
+    lr = jnp.float32(0.01)
+    key = jax.random.PRNGKey(0)
+
+    def step():
+        nonlocal key
+        key, sub = jax.random.split(key)
+        out = model.train_step_fn(
+            model.params, model.net_state, model.opt_state, xd, yd, lr, sub
+        )
+        model.params, model.net_state, model.opt_state = out[:3]
+        return out[3]
+
+    # warmup (compile + 2 steps); fence by value read — see the
+    # measurement note in ClassifierModel.train_iter (base.py): on this
+    # image's experimental axon PJRT backend, block_until_ready is not
+    # a reliable fence; reading the value is.
+    float(step())
+    float(step())
+
+    n_steps = 20
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        loss = step()
+    float(loss)  # forces the whole dependent chain
+    dt = time.perf_counter() - t0
+
+    global_batch = batch * n_chips
+    images_per_sec = n_steps * global_batch / dt
+    per_chip = images_per_sec / n_chips
+
+    baseline_path = REPO / "BENCH_BASELINE.json"
+    vs_baseline = None  # null = no recorded baseline for this flagship
+    if baseline_path.exists():
+        base = json.loads(baseline_path.read_text())
+        key_name = f"{modelclass}_images_per_sec_per_chip"
+        if base.get(key_name):
+            vs_baseline = round(per_chip / float(base[key_name]), 4)
+
+    print(
+        json.dumps(
+            {
+                "metric": f"{modelclass} images/sec/chip (BSP, bf16, b{batch})",
+                "value": round(per_chip, 2),
+                "unit": "images/sec/chip",
+                "vs_baseline": vs_baseline,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

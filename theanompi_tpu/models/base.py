@@ -210,15 +210,24 @@ class ClassifierModel(TMModel):
 
     # -- iteration fns (reference: model.train_iter / val_iter) -----------
 
-    def _put_batch(self, batch):
+    def put_batch(self, batch):
+        """Shard a host (x, y) batch onto the mesh's data axis."""
         x, y = batch
         return jax.device_put(jnp.asarray(x), self._data_sharding), \
             jax.device_put(jnp.asarray(y), self._data_sharding)
 
+    @property
+    def train_step_fn(self):
+        """The compiled SPMD train step:
+        ``(params, net_state, opt_state, x, y, lr, rng) ->
+        (params, net_state, opt_state, loss, err)``.
+        Public so benchmarks/drivers can run unfenced step chains."""
+        return self._train_step
+
     def train_iter(self, count: int, recorder: Recorder) -> None:
         recorder.start()
         batch = self.data.train_batch(count)
-        x, y = self._put_batch(batch)
+        x, y = self.put_batch(batch)
         recorder.end("wait")
 
         recorder.start()
@@ -238,13 +247,18 @@ class ClassifierModel(TMModel):
             jnp.float32(self.current_lr),
             step_key,
         )
-        loss.block_until_ready()
+        # Fence by VALUE READ, not block_until_ready: on this image's
+        # experimental 'axon' PJRT backend, block_until_ready returned
+        # before compute finished (measured 2026-07-29: 20 chained
+        # WRN-28-10 steps reported ready in 18ms; reading the loss
+        # value took 5.2s). float() is correct on every backend.
+        loss_v, err_v = float(loss), float(err)
         recorder.end("calc")
-        recorder.train_error(count, float(loss), float(err))
+        recorder.train_error(count, loss_v, err_v)
 
     def val_iter(self, count: int, recorder: Recorder):
         batch = self.data.val_batch(count)
-        x, y = self._put_batch(batch)
+        x, y = self.put_batch(batch)
         loss, err, err5 = self._val_step(self.params, self.net_state, x, y)
         return float(loss), float(err), float(err5)
 

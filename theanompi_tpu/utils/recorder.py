@@ -10,8 +10,8 @@ backprop inside one jitted step, so an honest ``comm`` segment cannot
 be measured by fencing two host calls the way the reference did.  The
 recorder therefore reports:
 
-- ``calc`` — time blocked in the train step (device-fenced via
-  ``block_until_ready`` when ``fence=True``),
+- ``calc`` — time blocked in the train step (device-fenced by the
+  caller reading the loss value; see ``ClassifierModel.train_iter``),
 - ``comm`` — host-driven exchange time (nonzero only for the async
   rules, whose elastic/gossip exchanges are separate dispatches),
 - ``wait`` — input-pipeline stalls (waiting on the next batch).
