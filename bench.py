@@ -23,47 +23,16 @@ import numpy as np
 
 REPO = Path(__file__).resolve().parent
 
-FLAGSHIP_CANDIDATES = [
-    # (modelfile, modelclass, config, per-chip batch)
-    (
-        "theanompi_tpu.models.resnet50",
-        "ResNet50",
-        {"batch_size": 128, "compute_dtype": "bfloat16"},
-        128,
-    ),
-    (
-        "theanompi_tpu.models.wresnet",
-        "WResNet",
-        {"batch_size": 256, "depth": 28, "widen": 10,
-         "compute_dtype": "bfloat16"},
-        256,
-    ),
-]
-
-
-def _load_flagship():
-    import importlib
-
-    for modelfile, modelclass, cfg, batch in FLAGSHIP_CANDIDATES:
-        try:
-            mod = importlib.import_module(modelfile)
-        except ImportError:
-            continue
-        cls = getattr(mod, modelclass, None)
-        if cls is not None:
-            return modelfile, modelclass, cls, cfg, batch
-    raise RuntimeError("no flagship model importable")
-
 
 def main() -> None:
+    from theanompi_tpu.models import load_flagship
     from theanompi_tpu.parallel import make_mesh, default_devices
 
     devices = default_devices()
     n_chips = len(devices)
     mesh = make_mesh(data=n_chips, devices=devices)
 
-    modelfile, modelclass, cls, cfg, batch = _load_flagship()
-    cfg = dict(cfg)
+    modelfile, modelclass, cls, cfg, batch = load_flagship()
     cfg["n_train"] = max(4 * batch * n_chips, 2048)
     cfg["n_val"] = batch * n_chips
     model = cls(cfg)

@@ -1,0 +1,89 @@
+"""ImageNet batch-file pipeline tests (reference: imagenet.py +
+proc_load_mpi.py behaviors: pre-batched files, shuffled file lists,
+crop/flip/mean-sub augmentation, async prefetch)."""
+
+import numpy as np
+import pytest
+
+from theanompi_tpu.models.data.imagenet import (
+    ImageNetData,
+    write_batch_files,
+)
+
+
+@pytest.fixture()
+def batch_dir(tmp_path, rng, monkeypatch):
+    """A tiny on-disk pre-batched dataset in the pipeline's format."""
+    n, gb = 24, 4
+    images = rng.integers(0, 255, size=(n, 64, 64, 3)).astype(np.float32)
+    labels = rng.integers(0, 1000, size=n).astype(np.int32)
+    write_batch_files(tmp_path, images, labels, gb, "train")
+    write_batch_files(tmp_path, images[:8], labels[:8], gb, "val")
+    np.save(
+        tmp_path / "imagenet_batches" / "img_mean.npy",
+        np.full((1, 64, 64, 3), 100.0, np.float32),
+    )
+    monkeypatch.setenv("TM_DATA_DIR", str(tmp_path))
+    return tmp_path, images, labels, gb
+
+
+class TestRealBatchFiles:
+    def test_reads_batches(self, batch_dir):
+        _, images, labels, gb = batch_dir
+        d = ImageNetData(batch_size=gb, n_replicas=1, crop=48)
+        assert not d.synthetic
+        assert d.n_batch_train == 6
+        assert d.n_batch_val == 2
+        d.shuffle(0)
+        x, y = d.train_batch(0)
+        assert x.shape == (gb, 48, 48, 3)
+        assert y.shape == (gb,)
+        # mean was subtracted: values centered around -100..155
+        assert x.mean() < 50.0
+
+    def test_val_center_crop_deterministic(self, batch_dir):
+        _, images, labels, gb = batch_dir
+        d = ImageNetData(batch_size=gb, n_replicas=1, crop=48)
+        x1, y1 = d.val_batch(0)
+        x2, y2 = d.val_batch(0)
+        np.testing.assert_array_equal(x1, x2)
+        np.testing.assert_array_equal(y1, labels[:gb])
+
+    def test_shuffle_changes_file_order(self, batch_dir):
+        _, _, _, gb = batch_dir
+        d = ImageNetData(batch_size=gb, n_replicas=1, crop=48)
+        d.shuffle(0)
+        perm0 = d._file_perm.copy()
+        d.shuffle(1)
+        assert not np.array_equal(perm0, d._file_perm)
+
+    def test_prefetch_sequential_consumption(self, batch_dir):
+        _, _, _, gb = batch_dir
+        d = ImageNetData(batch_size=gb, n_replicas=1, crop=48, prefetch_depth=2)
+        d.shuffle(0)
+        got = [d.train_batch(i) for i in range(d.n_batch_train)]
+        assert len(got) == 6
+        for x, y in got:
+            assert x.shape == (gb, 48, 48, 3)
+
+    def test_prefetch_matches_direct_load(self, batch_dir):
+        _, _, _, gb = batch_dir
+        d1 = ImageNetData(batch_size=gb, n_replicas=1, crop=48)
+        d1.shuffle(0)
+        via_prefetch = d1.train_batch(0)
+        d2 = ImageNetData(batch_size=gb, n_replicas=1, crop=48)
+        d2._epoch = 0
+        d2._file_perm = d1._file_perm
+        direct = d2._load_train(0)
+        np.testing.assert_array_equal(via_prefetch[0], direct[0])
+        np.testing.assert_array_equal(via_prefetch[1], direct[1])
+
+
+class TestSyntheticFallback:
+    def test_synthetic_when_no_files(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TM_DATA_DIR", str(tmp_path / "empty"))
+        d = ImageNetData(batch_size=2, n_replicas=2, crop=32, n_train=16, n_val=8)
+        assert d.synthetic
+        x, y = d.train_batch(0)
+        assert x.shape == (4, 32, 32, 3)
+        assert d.n_batch_train == 4

@@ -7,3 +7,39 @@ Every model satisfies the duck-typed contract the workers drive
 ``adjust_hyperp(epoch)``, and attributes ``params``, ``data``,
 ``epoch``, ``n_epochs``.
 """
+
+from __future__ import annotations
+
+import importlib
+
+# Flagship preference order shared by bench.py and __graft_entry__:
+# (modelfile, modelclass, bench config, per-chip bench batch).
+FLAGSHIP_CANDIDATES = [
+    (
+        "theanompi_tpu.models.resnet50",
+        "ResNet50",
+        {"batch_size": 128, "compute_dtype": "bfloat16"},
+        128,
+    ),
+    (
+        "theanompi_tpu.models.wresnet",
+        "WResNet",
+        {"batch_size": 256, "depth": 28, "widen": 10,
+         "compute_dtype": "bfloat16"},
+        256,
+    ),
+]
+
+
+def load_flagship():
+    """→ (modelfile, modelclass, model_cls, bench_cfg, bench_batch) for
+    the first importable flagship candidate."""
+    for modelfile, modelclass, cfg, batch in FLAGSHIP_CANDIDATES:
+        try:
+            mod = importlib.import_module(modelfile)
+        except ImportError:
+            continue
+        cls = getattr(mod, modelclass, None)
+        if cls is not None:
+            return modelfile, modelclass, cls, dict(cfg), batch
+    raise RuntimeError("no flagship model importable")

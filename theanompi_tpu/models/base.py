@@ -148,11 +148,11 @@ class ClassifierModel(TMModel):
         cdtype = self.compute_dtype
 
         def loss_fn(params, net_state, x, y, rng):
-            logits, new_state = net.apply(
+            out, new_state = net.apply(
                 params, net_state, x.astype(cdtype), train=True, rng=rng
             )
-            loss = softmax_cross_entropy(logits, y)
-            err = 1.0 - accuracy(logits, y)
+            loss = self.compute_loss(out, y)
+            err = 1.0 - accuracy(self.primary_logits(out), y)
             return loss, (new_state, err)
 
         def shard_train(params, net_state, opt_state, x, y, lr, rng):
@@ -171,9 +171,10 @@ class ClassifierModel(TMModel):
             return params, new_state, opt_state, loss, err
 
         def shard_val(params, net_state, x, y):
-            logits, _ = net.apply(
+            out, _ = net.apply(
                 params, net_state, x.astype(cdtype), train=False
             )
+            logits = self.primary_logits(out)
             loss = lax.pmean(softmax_cross_entropy(logits, y), DATA_AXIS)
             err = lax.pmean(1.0 - accuracy(logits, y), DATA_AXIS)
             err5 = lax.pmean(1.0 - accuracy(logits, y, k=5), DATA_AXIS)
@@ -207,6 +208,15 @@ class ClassifierModel(TMModel):
             (self.params, self.net_state, self.opt_state), rep_sharding
         )
         self._data_sharding = NamedSharding(self.mesh, P(DATA_AXIS))
+
+    # -- loss hooks (overridable; GoogLeNet adds aux-classifier terms) -----
+
+    def primary_logits(self, out):
+        """Extract the main logits from the net output (default: identity)."""
+        return out
+
+    def compute_loss(self, out, y):
+        return softmax_cross_entropy(self.primary_logits(out), y)
 
     # -- iteration fns (reference: model.train_iter / val_iter) -----------
 

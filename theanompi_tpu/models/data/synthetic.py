@@ -38,9 +38,20 @@ class SyntheticClassData:
         self.dtype = dtype
 
         rng = np.random.default_rng(seed)
-        self.templates = rng.normal(
-            size=(n_classes, *self.input_shape)
+        # Coarse class templates (<=16px per spatial dim), upsampled on
+        # demand — a full-res (1000, 224, 224, 3) float32 table would
+        # cost ~600 MB per ImageNet-shaped instance for no test value.
+        self._coarse_shape = tuple(
+            min(16, d) if i < max(len(self.input_shape) - 1, 1) else d
+            for i, d in enumerate(self.input_shape)
+        )
+        self._coarse = rng.normal(
+            size=(n_classes, *self._coarse_shape)
         ).astype(dtype)
+        self._upsample_idx = [
+            (np.arange(full) * coarse // full)
+            for full, coarse in zip(self.input_shape, self._coarse_shape)
+        ]
         self._train_y = rng.integers(0, n_classes, self.n_train).astype(np.int32)
         self._val_y = rng.integers(0, n_classes, self.n_val).astype(np.int32)
         self._train_seed = seed + 1
@@ -51,9 +62,16 @@ class SyntheticClassData:
         rng = np.random.default_rng(self._train_seed + epoch)
         self._perm = rng.permutation(self.n_train)
 
+    def _template(self, ys: np.ndarray) -> np.ndarray:
+        t = self._coarse[ys]
+        for axis, idx in enumerate(self._upsample_idx):
+            if len(idx) != t.shape[axis + 1]:
+                t = np.take(t, idx, axis=axis + 1)
+        return t
+
     def _make(self, ys: np.ndarray, seed: int) -> tuple[np.ndarray, np.ndarray]:
         rng = np.random.default_rng(seed)
-        x = self.templates[ys] + self.noise * rng.normal(
+        x = self._template(ys) + self.noise * rng.normal(
             size=(len(ys), *self.input_shape)
         ).astype(self.dtype)
         return x.astype(self.dtype), ys

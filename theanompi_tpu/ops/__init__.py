@@ -14,6 +14,7 @@ from theanompi_tpu.ops.layers import (
     Layer,
     Sequential,
     Conv,
+    Concat,
     Pool,
     LRN,
     BN,
@@ -38,6 +39,7 @@ __all__ = [
     "Layer",
     "Sequential",
     "Conv",
+    "Concat",
     "Pool",
     "LRN",
     "BN",

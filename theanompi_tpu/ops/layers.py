@@ -276,6 +276,41 @@ class Dropout(Layer):
         return jnp.where(mask, x / keep, 0).astype(x.dtype), state
 
 
+class Concat(Layer):
+    """Parallel branches concatenated on the channel axis (Inception)."""
+
+    def __init__(self, branches: Sequence["Layer"]):
+        self.branches = list(branches)
+
+    def init(self, key, in_shape):
+        keys = jax.random.split(key, len(self.branches))
+        params, state, shapes = [], [], []
+        for k, b in zip(keys, self.branches):
+            p, s, sh = b.init(k, in_shape)
+            params.append(p)
+            state.append(s)
+            shapes.append(sh)
+        h, w = shapes[0][:2]
+        assert all(sh[:2] == (h, w) for sh in shapes), (
+            f"branch spatial shapes differ: {shapes}"
+        )
+        out = (h, w, sum(sh[2] for sh in shapes))
+        return params, state, out
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        rngs = (
+            jax.random.split(rng, len(self.branches))
+            if rng is not None
+            else [None] * len(self.branches)
+        )
+        ys, new_state = [], []
+        for b, p, s, r in zip(self.branches, params, state, rngs):
+            y, s2 = b.apply(p, s, x, train=train, rng=r)
+            ys.append(y)
+            new_state.append(s2)
+        return jnp.concatenate(ys, axis=-1), new_state
+
+
 class GlobalAvgPool(Layer):
     """Spatial global average pool: NHWC -> NC."""
 
