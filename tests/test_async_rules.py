@@ -1,0 +1,173 @@
+"""Async-rule tests: EASGD and GoSGD workers end-to-end on the fake
+8-device mesh, plus the dynamic-routing gossip math (the reference
+validated these only by training real clusters — SURVEY §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import theanompi_tpu
+from theanompi_tpu.parallel import gossip_matrix_round
+from theanompi_tpu.workers import easgd_worker, gosgd_worker
+
+TINY = {
+    "batch_size": 4,
+    "depth": 10,
+    "widen": 1,
+    "lr": 0.05,
+    "lr_schedule": None,
+    "n_train": 256,
+    "n_val": 64,
+}
+
+
+def _run_easgd(n_epochs=1, devices=8, config_extra=None, **kw):
+    return easgd_worker.run(
+        devices=list(range(devices)),
+        modelfile="theanompi_tpu.models.wresnet",
+        modelclass="WResNet",
+        config={**TINY, "n_epochs": n_epochs, **(config_extra or {})},
+        verbose=False,
+        **kw,
+    )
+
+
+def _run_gosgd(n_epochs=1, devices=8, config_extra=None, **kw):
+    return gosgd_worker.run(
+        devices=list(range(devices)),
+        modelfile="theanompi_tpu.models.wresnet",
+        modelclass="WResNet",
+        config={**TINY, "n_epochs": n_epochs, **(config_extra or {})},
+        verbose=False,
+        **kw,
+    )
+
+
+class TestGossipMatrixRound:
+    """Unit tests of the dynamic-routing gossip round against the
+    reference's sequential message semantics (SURVEY §3.3)."""
+
+    def test_single_push_matches_reference_merge(self):
+        w = 4
+        params = {"w": jnp.arange(w * 3, dtype=jnp.float32).reshape(w, 3)}
+        scores = jnp.array([0.4, 0.3, 0.2, 0.1], jnp.float32)
+        # worker 0 pushes to worker 2; nobody else pushes
+        route = jnp.array([2, 0, 0, 0], jnp.int32)
+        push = jnp.array([1.0, 0.0, 0.0, 0.0], jnp.float32)
+        merged, new_scores = gossip_matrix_round(params, scores, route, push)
+
+        s0, s2 = 0.4, 0.2
+        sent = s0 / 2
+        # sender: score halved, params unchanged
+        assert np.isclose(new_scores[0], s0 - sent)
+        np.testing.assert_allclose(merged["w"][0], params["w"][0])
+        # receiver: score-weighted merge + score sum
+        assert np.isclose(new_scores[2], s2 + sent)
+        expect = (s2 * params["w"][2] + sent * params["w"][0]) / (s2 + sent)
+        np.testing.assert_allclose(merged["w"][2], expect, rtol=1e-6)
+        # bystanders untouched
+        np.testing.assert_allclose(merged["w"][1], params["w"][1])
+        np.testing.assert_allclose(
+            np.asarray(new_scores)[[1, 3]], np.asarray(scores)[[1, 3]]
+        )
+
+    def test_scores_conserved(self):
+        w = 8
+        rng = np.random.default_rng(0)
+        params = {"w": jnp.asarray(rng.normal(size=(w, 5)), jnp.float32)}
+        scores = jnp.full((w,), 1.0 / w, jnp.float32)
+        for trial in range(5):
+            route = rng.integers(0, w - 1, w)
+            route += route >= np.arange(w)
+            push = (rng.random(w) < 0.5).astype(np.float32)
+            params, scores = gossip_matrix_round(
+                params, scores, jnp.asarray(route, jnp.int32),
+                jnp.asarray(push, jnp.float32),
+            )
+            assert np.isclose(float(jnp.sum(scores)), 1.0, atol=1e-5)
+
+    def test_all_push_keeps_param_scale(self):
+        """Merges are convex combinations — values stay in hull."""
+        w = 4
+        params = {"w": jnp.ones((w, 2), jnp.float32) * jnp.arange(
+            1.0, w + 1.0)[:, None]}
+        scores = jnp.full((w,), 0.25, jnp.float32)
+        route = jnp.array([1, 2, 3, 0], jnp.int32)
+        push = jnp.ones((w,), jnp.float32)
+        merged, _ = gossip_matrix_round(params, scores, route, push)
+        assert float(jnp.min(merged["w"])) >= 1.0 - 1e-5
+        assert float(jnp.max(merged["w"])) <= 4.0 + 1e-5
+
+
+class TestEASGDEndToEnd:
+    def test_convergence_smoke(self):
+        res = _run_easgd(
+            n_epochs=3, config_extra={"n_train": 512}, tau=2
+        )
+        assert res["epochs"] == 3
+        assert res["exchanges"] > 0
+        assert res["final_val"]["err"] < 0.25
+        assert res["final_train_loss"] < 1.0
+
+    def test_comm_segment_measured(self):
+        res = _run_easgd(n_epochs=1, tau=2)
+        rec = res["recorder"]
+        assert rec.epoch_segments["comm"] > 0.0
+
+    def test_checkpoint_resume(self, tmp_path):
+        ckpt = str(tmp_path / "ck")
+        res1 = _run_easgd(n_epochs=1, checkpoint_dir=ckpt, tau=2)
+        res2 = _run_easgd(
+            n_epochs=3, checkpoint_dir=ckpt, resume=True, tau=2
+        )
+        assert res2["epochs"] == 3
+        assert len(res2["epoch_times"]) == 3
+        # windowed means: async per-batch losses are noisy, so compare
+        # the first training window against the final one
+        losses = res2["recorder"].train_losses
+        assert np.mean(losses[-8:]) < np.mean(losses[:8])
+
+    def test_rule_api(self):
+        rule = theanompi_tpu.EASGD()
+        rule.init(
+            workers=list(range(8)),
+            modelfile="theanompi_tpu.models.wresnet",
+            modelclass="WResNet",
+            launch="inprocess",
+            config={**TINY, "n_epochs": 1},
+            tau=4,
+            verbose=False,
+        )
+        result = rule.wait()
+        assert result["epochs"] == 1
+        assert result["exchanges"] > 0
+
+
+class TestGoSGDEndToEnd:
+    def test_single_worker_rejected(self):
+        with pytest.raises(ValueError, match=">= 2 workers"):
+            _run_gosgd(devices=1)
+
+    def test_convergence_smoke(self):
+        res = _run_gosgd(
+            n_epochs=3, config_extra={"n_train": 512}, push_prob=0.5
+        )
+        assert res["epochs"] == 3
+        assert res["gossip_rounds"] > 0
+        assert res["final_val"]["err"] < 0.25
+        assert res["final_train_loss"] < 1.0
+
+    def test_rule_api(self):
+        rule = theanompi_tpu.GOSGD()
+        rule.init(
+            devices=list(range(8)),
+            modelfile="theanompi_tpu.models.wresnet",
+            modelclass="WResNet",
+            launch="inprocess",
+            config={**TINY, "n_epochs": 1},
+            verbose=False,
+        )
+        result = rule.wait()
+        assert result["epochs"] == 1
+        assert result["gossip_rounds"] > 0

@@ -19,8 +19,11 @@ from theanompi_tpu.parallel.mesh import (
 from theanompi_tpu.parallel.exchange import (
     allreduce_mean,
     elastic_pair_update,
+    elastic_center_merge,
     gossip_push,
     gossip_merge,
+    gossip_matrix_round,
+    replica_consistency_delta,
 )
 from theanompi_tpu.parallel.strategies import (
     ExchangeStrategy,
@@ -38,8 +41,11 @@ __all__ = [
     "num_devices",
     "allreduce_mean",
     "elastic_pair_update",
+    "elastic_center_merge",
     "gossip_push",
     "gossip_merge",
+    "gossip_matrix_round",
+    "replica_consistency_delta",
     "ExchangeStrategy",
     "get_strategy",
     "STRATEGIES",

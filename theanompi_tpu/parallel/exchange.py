@@ -174,6 +174,50 @@ def gossip_push(
     return merged, total
 
 
+def gossip_matrix_round(
+    stacked_params: PyTree,
+    scores: jnp.ndarray,
+    route: jnp.ndarray,
+    push_mask: jnp.ndarray,
+) -> tuple[PyTree, jnp.ndarray]:
+    """One gossip round over a stacked leading worker axis, with
+    *dynamic* peer routing (no recompile per random draw).
+
+    The reference samples a fresh random peer every pushing iteration;
+    a ``ppermute`` permutation is a static jit argument, so expressing
+    the round that way would recompile per draw.  Instead the push is a
+    score-weighted routing matrix ``R[s, d] = onehot(route)[s, d] *
+    sent_score[s]`` and delivery is a tiny ``[W, W] x [W, ...]``
+    contraction — XLA lowers it to a cross-device reduce over the
+    sharded worker axis, and ``route``/``push_mask`` stay runtime
+    arrays.
+
+    ``stacked_params`` — pytree with leading axis W (one slot per
+    worker); ``scores`` — ``[W]``; ``route`` — ``[W]`` int destination
+    worker for each source; ``push_mask`` — ``[W]`` {0,1}, 1 = this
+    worker pushes this round.
+
+    Simultaneous deliveries merge in one step: the score-weighted merge
+    is linear, so absorbing k senders at once equals the reference's
+    sequential queue drain of the same k messages.
+    """
+    w = scores.shape[0]
+    sent = push_mask.astype(scores.dtype) * scores * 0.5
+    kept = scores - sent                            # halved iff pushing
+    routing = jax.nn.one_hot(route, w, dtype=scores.dtype) * sent[:, None]
+    recv_score = jnp.sum(routing, axis=0)           # [W] per destination
+    new_scores = kept + recv_score
+
+    def merge(p):
+        f32 = p.astype(jnp.float32)
+        recv = jnp.tensordot(routing, f32, axes=[[0], [0]])  # [W, ...]
+        own = kept.reshape((w,) + (1,) * (f32.ndim - 1)) * f32
+        tot = new_scores.reshape((w,) + (1,) * (f32.ndim - 1))
+        return ((own + recv) / tot).astype(p.dtype)
+
+    return jax.tree.map(merge, stacked_params), new_scores
+
+
 def gossip_merge(
     params_a: PyTree, score_a, params_b: PyTree, score_b
 ) -> tuple[PyTree, jnp.ndarray]:

@@ -1,0 +1,185 @@
+"""EASGD: asynchronous elastic-averaging SGD (Zhang et al. 2015).
+
+Reference: ``theanompi/easgd_server.py`` + ``easgd_worker.py`` —
+a dedicated server process holds the center parameters and serialises
+worker requests; each worker runs ``tau`` local SGD steps then does an
+MPI Sendrecv elastic exchange (``w_i -= α(w_i − w_c)`` worker-side,
+``w_c += α(w_i − w_c)`` server-side); the server also runs validation
+on the center weights and owns the checkpoint (SURVEY §3.2).
+
+TPU-native shape: the "server" is not a process — the center is a
+replicated ``jax.Array`` pytree owned by the controller, and the N
+workers are per-device replicas with a stacked sharded worker axis
+(``ReplicaEngine``).  Every ``tau`` batches the controller dispatches
+one jitted ``elastic_center_merge``: each worker pulls against the same
+center snapshot and the center absorbs the summed pushes — equivalent
+to the reference's request queue draining within one cadence window,
+but executed as a single cross-device reduce over ICI instead of N
+serialized Sendrecvs over PCIe/IB.
+
+Validation + checkpoint use the center weights (server semantics);
+``comm`` wall-clock in the recorder is the real host-dispatched
+exchange time, matching the reference's measurement.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from theanompi_tpu import launcher as _launcher
+from theanompi_tpu.parallel import elastic_center_merge
+from theanompi_tpu.utils import Recorder
+from theanompi_tpu.workers.bsp_worker import _build_mesh, _resolve_model
+from theanompi_tpu.workers.replica_engine import ReplicaEngine
+
+
+def run(
+    devices: Sequence[Any] | None = None,
+    modelfile: str = "",
+    modelclass: str = "",
+    *,
+    config: dict | None = None,
+    alpha: float | None = None,
+    tau: int | None = None,
+    server_device: Any = None,  # reference API compat; center is virtual
+    n_epochs: int | None = None,
+    checkpoint_dir: str | None = None,
+    resume: bool = False,
+    print_freq: int = 40,
+    verbose: bool = True,
+    **extra: Any,
+) -> dict:
+    """Train ``modelclass`` under EASGD; returns a summary dict.
+
+    ``alpha`` — elastic coupling strength (reference default: the
+    moving-rate config knob, commonly ``alpha = 1/N``); ``tau`` —
+    local steps between exchanges (reference default 1–16).
+    """
+    del server_device  # no dedicated chip needed: center is replicated
+    mesh = _build_mesh(devices)
+    n_workers = mesh.shape["data"]
+
+    Model = _resolve_model(modelfile, modelclass)
+    cfg = dict(config or {})
+    cfg.update(extra)
+    if n_epochs is not None:
+        cfg["n_epochs"] = n_epochs
+    model = Model(cfg)
+    model.build_model(n_replicas=n_workers)
+
+    alpha = float(alpha if alpha is not None
+                  else cfg.get("alpha", 1.0 / n_workers))
+    tau = int(tau if tau is not None else cfg.get("tau", 4))
+    if alpha * n_workers > 1.0:
+        # Synchronous EASGD center step is c += sum_i alpha*(w_i - c);
+        # the effective center rate beta = alpha*N must be <= 1 (Zhang
+        # et al. 2015, §4 stability condition) or the center oscillates
+        # and diverges.
+        import warnings
+
+        warnings.warn(
+            f"EASGD alpha={alpha} with {n_workers} workers gives "
+            f"beta={alpha * n_workers:.2f} > 1: unstable. Use "
+            f"alpha <= {1.0 / n_workers:.4f}.",
+            stacklevel=2,
+        )
+
+    recorder = Recorder(
+        rank=0, size=n_workers, print_freq=print_freq, verbose=verbose
+    )
+    if resume and checkpoint_dir:
+        if model.load(checkpoint_dir, recorder):
+            model.epoch += 1
+            if verbose:
+                print(f"resumed from epoch {model.epoch - 1}", flush=True)
+
+    # ReplicaEngine stacks model.params — which model.load() above has
+    # already replaced on resume, so workers restart from the restored
+    # center (with the checkpointed consensus momentum) automatically.
+    engine = ReplicaEngine(model, mesh)
+    center = jax.device_put(model.params, engine.replicated)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def exchange(stacked, c):
+        return elastic_center_merge(stacked, c, alpha)
+
+    data = model.data
+    if verbose:
+        print(
+            f"EASGD: {n_workers} workers, alpha={alpha:.4f} tau={tau}, "
+            f"{data.n_batch_train} train batches x {data.global_batch} "
+            f"global batch",
+            flush=True,
+        )
+
+    step = 0
+    while model.epoch < model.n_epochs:
+        epoch = model.epoch
+        recorder.start_epoch()
+        if hasattr(data, "shuffle"):
+            data.shuffle(epoch)
+        for i in range(data.n_batch_train):
+            recorder.start()
+            batch = data.train_batch(i)
+            recorder.end("wait")
+
+            recorder.start()
+            loss, err = engine.train_step(batch, model.current_lr)
+            loss_v, err_v = float(loss), float(err)  # value-read fence
+            recorder.end("calc")
+            recorder.train_error(i, loss_v, err_v)
+
+            step += 1
+            if step % tau == 0:
+                recorder.start()
+                engine.params, center = exchange(engine.params, center)
+                # value-read fence (see ClassifierModel.train_iter note)
+                _ = float(
+                    jax.tree.leaves(center)[0].reshape(-1)[0]
+                )
+                recorder.end("comm")
+            recorder.print_train_info(i)
+
+        if data.n_batch_val:
+            # server semantics: validate the CENTER weights
+            l, e, e5 = engine.validate(
+                data, params=center, net_state=engine.mean_net_state()
+            )
+            recorder.val_error(l, e, e5)
+
+        recorder.end_epoch(epoch)
+        model.adjust_hyperp(epoch + 1)
+        if checkpoint_dir:
+            # center owns the checkpoint (reference: server saves);
+            # consensus momentum rides along so resume keeps velocity
+            model.params = center
+            model.net_state = engine.mean_net_state()
+            model.opt_state = engine.mean_opt_state()
+            model.save(checkpoint_dir, recorder)
+        model.epoch += 1
+
+    model.params = center
+    model.net_state = engine.mean_net_state()
+    model.opt_state = engine.mean_opt_state()
+
+    last_val = recorder.val_records[-1] if recorder.val_records else {}
+    return {
+        "epochs": model.epoch,
+        "iterations": recorder.n_iter,
+        "exchanges": step // tau,
+        "final_train_loss": (
+            recorder.train_losses[-1] if recorder.train_losses else None
+        ),
+        "final_val": last_val,
+        "epoch_times": recorder.epoch_times,
+        "recorder": recorder,
+        "model": model,
+    }
+
+
+if __name__ == "__main__":
+    _launcher.worker_main(run)

@@ -1,0 +1,183 @@
+"""GoSGD: asynchronous gossip SGD (Blot et al. 2016).
+
+Reference: ``theanompi/gosgd_worker.py`` — every iteration each worker
+trains locally, then with probability ``p`` picks a random peer and
+``isend``s ``(params, score/2)`` to it, halving its own score; on
+receive, the peer merges parameters weighted by scores and adds the
+scores (SURVEY §3.3).
+
+TPU-native shape: workers are per-device replicas with a stacked
+sharded worker axis (``ReplicaEngine``); one gossip round is a single
+jitted score-weighted routing contraction
+(``parallel.exchange.gossip_matrix_round``) whose Bernoulli push mask
+and random destinations are host-sampled *runtime arrays* — the random
+draw changes every round without recompiling, and XLA lowers the
+delivery to one cross-device reduce over ICI instead of point-to-point
+MPI messages.
+
+Validation/checkpoint use the score-weighted consensus (the natural
+"final model" of gossip averaging; the reference just took any
+worker's weights, which the consensus dominates).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from theanompi_tpu import launcher as _launcher
+from theanompi_tpu.parallel import gossip_matrix_round
+from theanompi_tpu.utils import Recorder
+from theanompi_tpu.workers.bsp_worker import _build_mesh, _resolve_model
+from theanompi_tpu.workers.replica_engine import ReplicaEngine
+
+
+def run(
+    devices: Sequence[Any] | None = None,
+    modelfile: str = "",
+    modelclass: str = "",
+    *,
+    config: dict | None = None,
+    push_prob: float | None = None,
+    n_epochs: int | None = None,
+    checkpoint_dir: str | None = None,
+    resume: bool = False,
+    print_freq: int = 40,
+    verbose: bool = True,
+    seed: int | None = None,
+    **extra: Any,
+) -> dict:
+    """Train ``modelclass`` under GoSGD; returns a summary dict.
+
+    ``push_prob`` — per-worker per-iteration Bernoulli push probability
+    (the reference's ``p``; its IMDB LSTM demo used small p)."""
+    mesh = _build_mesh(devices)
+    n_workers = mesh.shape["data"]
+    if n_workers < 2:
+        raise ValueError(
+            "GoSGD needs >= 2 workers (devices) to gossip between; "
+            f"got {n_workers}. Use BSP for single-device training."
+        )
+
+    Model = _resolve_model(modelfile, modelclass)
+    cfg = dict(config or {})
+    cfg.update(extra)
+    if n_epochs is not None:
+        cfg["n_epochs"] = n_epochs
+    model = Model(cfg)
+    model.build_model(n_replicas=n_workers)
+
+    p_push = float(
+        push_prob if push_prob is not None else cfg.get("push_prob", 0.25)
+    )
+
+    recorder = Recorder(
+        rank=0, size=n_workers, print_freq=print_freq, verbose=verbose
+    )
+    if resume and checkpoint_dir:
+        if model.load(checkpoint_dir, recorder):
+            model.epoch += 1
+            if verbose:
+                print(f"resumed from epoch {model.epoch - 1}", flush=True)
+
+    # ReplicaEngine stacks model.params — already the restored
+    # consensus weights on resume, so no re-broadcast is needed.
+    engine = ReplicaEngine(model, mesh)
+    # each worker starts with score 1/W (reference: scores sum to 1)
+    scores = jax.device_put(
+        jnp.full((n_workers,), 1.0 / n_workers, jnp.float32),
+        engine.replicated,
+    )
+
+    gossip = jax.jit(gossip_matrix_round, donate_argnums=(0,))
+    host_rng = np.random.default_rng(
+        seed if seed is not None else model.seed + 101
+    )
+
+    data = model.data
+    if verbose:
+        print(
+            f"GoSGD: {n_workers} workers, p={p_push}, "
+            f"{data.n_batch_train} train batches x {data.global_batch} "
+            f"global batch",
+            flush=True,
+        )
+
+    n_rounds = 0
+    while model.epoch < model.n_epochs:
+        epoch = model.epoch
+        recorder.start_epoch()
+        if hasattr(data, "shuffle"):
+            data.shuffle(epoch)
+        for i in range(data.n_batch_train):
+            recorder.start()
+            batch = data.train_batch(i)
+            recorder.end("wait")
+
+            recorder.start()
+            loss, err = engine.train_step(batch, model.current_lr)
+            loss_v, err_v = float(loss), float(err)  # value-read fence
+            recorder.end("calc")
+            recorder.train_error(i, loss_v, err_v)
+
+            # host-sampled gossip round (reference: Bernoulli(p) isend
+            # to a uniform random peer != self)
+            push = host_rng.random(n_workers) < p_push
+            if push.any():
+                recorder.start()
+                route = host_rng.integers(0, n_workers - 1, n_workers)
+                route += route >= np.arange(n_workers)  # peer != self
+                engine.params, scores = gossip(
+                    engine.params,
+                    scores,
+                    jnp.asarray(route, jnp.int32),
+                    jnp.asarray(push, jnp.float32),
+                )
+                _ = float(scores[0])  # value-read fence
+                recorder.end("comm")
+                n_rounds += 1
+            recorder.print_train_info(i)
+
+        if data.n_batch_val:
+            # consensus weights = score-weighted average of all workers
+            l, e, e5 = engine.validate(
+                data,
+                params=engine.mean_params(scores),
+                net_state=engine.mean_net_state(scores),
+            )
+            recorder.val_error(l, e, e5)
+
+        recorder.end_epoch(epoch)
+        model.adjust_hyperp(epoch + 1)
+        if checkpoint_dir:
+            model.params = engine.mean_params(scores)
+            model.net_state = engine.mean_net_state(scores)
+            model.opt_state = engine.mean_opt_state(scores)
+            model.save(checkpoint_dir, recorder)
+        model.epoch += 1
+
+    model.params = engine.mean_params(scores)
+    model.net_state = engine.mean_net_state(scores)
+    model.opt_state = engine.mean_opt_state(scores)
+
+    last_val = recorder.val_records[-1] if recorder.val_records else {}
+    return {
+        "epochs": model.epoch,
+        "iterations": recorder.n_iter,
+        "gossip_rounds": n_rounds,
+        "final_train_loss": (
+            recorder.train_losses[-1] if recorder.train_losses else None
+        ),
+        "final_val": last_val,
+        "epoch_times": recorder.epoch_times,
+        "recorder": recorder,
+        "model": model,
+    }
+
+
+if __name__ == "__main__":
+    _launcher.worker_main(run)
