@@ -145,11 +145,10 @@ class ClassifierModel(TMModel):
         )
         net = self.net
         optimizer = self.optimizer
-        cdtype = self.compute_dtype
 
         def loss_fn(params, net_state, x, y, rng):
             out, new_state = net.apply(
-                params, net_state, x.astype(cdtype), train=True, rng=rng
+                params, net_state, self.prep_input(x), train=True, rng=rng
             )
             loss = self.compute_loss(out, y)
             err = 1.0 - accuracy(self.primary_logits(out), y)
@@ -172,7 +171,7 @@ class ClassifierModel(TMModel):
 
         def shard_val(params, net_state, x, y):
             out, _ = net.apply(
-                params, net_state, x.astype(cdtype), train=False
+                params, net_state, self.prep_input(x), train=False
             )
             logits = self.primary_logits(out)
             loss = lax.pmean(softmax_cross_entropy(logits, y), DATA_AXIS)
@@ -210,6 +209,11 @@ class ClassifierModel(TMModel):
         self._data_sharding = NamedSharding(self.mesh, P(DATA_AXIS))
 
     # -- loss hooks (overridable; GoogLeNet adds aux-classifier terms) -----
+
+    def prep_input(self, x):
+        """Cast/transform the raw batch before the net sees it (default:
+        cast to compute dtype; token-id models keep ints — see lstm.py)."""
+        return x.astype(self.compute_dtype)
 
     def primary_logits(self, out):
         """Extract the main logits from the net output (default: identity)."""

@@ -373,7 +373,11 @@ def softmax_cross_entropy(logits, labels) -> jnp.ndarray:
 
 
 def accuracy(logits, labels, k: int = 1) -> jnp.ndarray:
-    """Top-k accuracy (reference reported top-1/top-5 errors)."""
+    """Top-k accuracy (reference reported top-1/top-5 errors).
+
+    ``k`` is clamped to the class count so top-5 reporting stays valid
+    on few-class heads (e.g. IMDB's 2)."""
+    k = min(k, logits.shape[-1])
     if k == 1:
         return jnp.mean(jnp.argmax(logits, -1) == labels)
     topk = jax.lax.top_k(logits, k)[1]

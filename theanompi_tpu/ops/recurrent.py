@@ -1,0 +1,128 @@
+"""Recurrent layers: Embedding + LSTM (the reference's Lasagne-zoo
+LSTM capability, rebuilt TPU-first).
+
+Reference: ``theanompi/models/lasagne_model_zoo/lstm.py`` — a Lasagne
+LSTM for IMDB sentiment (the GoSGD demo; named in BASELINE.json).
+Rebuild notes:
+
+- The recurrence is a ``lax.scan`` over time — ONE compiled loop, no
+  Python unrolling, so XLA pipelines the per-step ``[B, E+H] x
+  [E+H, 4H]`` gate matmul onto the MXU.
+- Variable-length sequences use a {0,1} mask carried *through* the
+  scan (padded steps hold h/c), then masked mean-pooling — the classic
+  Theano IMDB LSTM recipe.  Shapes stay static (pad to ``maxlen``):
+  dynamic lengths would retrace under jit and defeat MXU tiling, so
+  host-side bucketing is deliberately NOT used (SURVEY §1 L0 / XLA
+  semantics).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from theanompi_tpu.ops import initializers
+from theanompi_tpu.ops.layers import Layer
+
+
+class Embedding(Layer):
+    """Token-id → vector table lookup.
+
+    ``out_dtype`` sets the activation dtype leaving the table (int ids
+    carry no dtype to infer from, unlike Conv/FC which follow x.dtype).
+    """
+
+    def __init__(self, vocab: int, dim: int, *,
+                 w_init=initializers.normal(0.01), out_dtype=None):
+        self.vocab = vocab
+        self.dim = dim
+        self.w_init = initializers.get(w_init)
+        self.out_dtype = out_dtype
+
+    def init(self, key, in_shape):
+        params = {"w": self.w_init(key, (self.vocab, self.dim))}
+        return params, {}, (*in_shape, self.dim)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        ids = x.astype(jnp.int32)
+        w = params["w"]
+        if self.out_dtype is not None:
+            w = w.astype(self.out_dtype)
+        return w[ids], state
+
+
+class LSTM(Layer):
+    """Single-layer LSTM over ``[B, T, E]`` → pooled ``[B, H]``.
+
+    ``pool`` — 'mean' (masked mean of hidden states, the Theano IMDB
+    recipe), 'last' (hidden state at the final valid step), or 'seq'
+    (full ``[B, T, H]`` sequence for stacking).
+    Forget-gate bias initialized to 1 (standard trick the 2016-era
+    reference predates; keeps gradients alive early).
+    """
+
+    def __init__(self, hidden: int, *, pool: str = "mean",
+                 w_init=initializers.xavier()):
+        assert pool in ("mean", "last", "seq")
+        self.hidden = hidden
+        self.pool = pool
+        self.w_init = initializers.get(w_init)
+
+    def init(self, key, in_shape):
+        t, e = in_shape
+        h = self.hidden
+        k1, k2 = jax.random.split(key)
+        params = {
+            "wx": self.w_init(k1, (e, 4 * h)),
+            "wh": self.w_init(k2, (h, 4 * h)),
+            # gate order (i, f, g, o); forget bias = 1
+            "b": jnp.zeros((4 * h,)).at[h : 2 * h].set(1.0),
+        }
+        out = (t, h) if self.pool == "seq" else (h,)
+        return params, {}, out
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        b, t, e = x.shape
+        h_dim = self.hidden
+        dtype = x.dtype
+        wx = params["wx"].astype(dtype)
+        wh = params["wh"].astype(dtype)
+        bias = params["b"].astype(dtype)
+
+        if mask is None:
+            mask = jnp.ones((b, t), dtype)
+        else:
+            mask = mask.astype(dtype)
+
+        # pre-compute input projections for ALL steps in one big MXU
+        # matmul [B*T, E] x [E, 4H]; the scan then only does the
+        # [B, H] x [H, 4H] recurrent half per step.
+        xz = (x.reshape(b * t, e) @ wx).reshape(b, t, 4 * h_dim) + bias
+
+        def step(carry, inp):
+            h, c = carry
+            xz_t, m_t = inp                      # [B, 4H], [B]
+            z = xz_t + h @ wh
+            i, f, g, o = jnp.split(z, 4, axis=-1)
+            c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+            h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+            m = m_t[:, None]
+            h = m * h_new + (1 - m) * h          # hold state on padding
+            c = m * c_new + (1 - m) * c
+            return (h, c), h
+
+        h0 = jnp.zeros((b, h_dim), dtype)
+        (h_last, _), hs = jax.lax.scan(
+            step,
+            (h0, h0),
+            (jnp.swapaxes(xz, 0, 1), jnp.swapaxes(mask, 0, 1)),
+        )
+        hs = jnp.swapaxes(hs, 0, 1)              # [B, T, H]
+
+        if self.pool == "seq":
+            return hs, state
+        if self.pool == "last":
+            return h_last, state
+        denom = jnp.maximum(jnp.sum(mask, axis=1, keepdims=True), 1.0)
+        pooled = jnp.sum(hs * mask[:, :, None], axis=1) / denom
+        return pooled, state

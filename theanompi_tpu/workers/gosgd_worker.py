@@ -15,9 +15,14 @@ draw changes every round without recompiling, and XLA lowers the
 delivery to one cross-device reduce over ICI instead of point-to-point
 MPI messages.
 
-Validation/checkpoint use the score-weighted consensus (the natural
-"final model" of gossip averaging; the reference just took any
-worker's weights, which the consensus dominates).
+Validation runs per-replica (each worker scores its own shard of the
+val set — exactly what the reference's N processes reported), and the
+checkpoint takes the highest-score worker's weights (the reference
+took any worker's).  Score-weighted *averaging* of replicas is
+deliberately NOT used as the final model: under sparse gossip the
+replicas are independently-trained networks whose parameter average is
+meaningless (permutation symmetry), and measuring it oscillates
+between degenerate one-class predictors.
 """
 
 from __future__ import annotations
@@ -34,6 +39,20 @@ from theanompi_tpu.parallel import gossip_matrix_round
 from theanompi_tpu.utils import Recorder
 from theanompi_tpu.workers.bsp_worker import _build_mesh, _resolve_model
 from theanompi_tpu.workers.replica_engine import ReplicaEngine
+
+
+def _adopt_best(model, engine, scores) -> None:
+    """Copy the highest-score worker's replica into the model slot
+    (reference semantics: any worker's weights are the model; the top
+    score has absorbed the most gossip mass)."""
+    k = int(jnp.argmax(scores))
+
+    def take(tree):
+        return jax.tree.map(lambda x: x[k], tree)
+
+    model.params = take(engine.params)
+    model.net_state = take(engine.net_state)
+    model.opt_state = take(engine.opt_state)
 
 
 def run(
@@ -131,38 +150,38 @@ def run(
                 recorder.start()
                 route = host_rng.integers(0, n_workers - 1, n_workers)
                 route += route >= np.arange(n_workers)  # peer != self
-                engine.params, scores = gossip(
-                    engine.params,
+                # momentum travels with the params: merging weights but
+                # keeping each worker's stale velocity makes the
+                # consensus oscillate (momentum then points away from
+                # the merged point), so the whole (params, opt) pair is
+                # averaged with the same scores.
+                merged, scores = gossip(
+                    {"params": engine.params, "opt": engine.opt_state},
                     scores,
                     jnp.asarray(route, jnp.int32),
                     jnp.asarray(push, jnp.float32),
                 )
+                engine.params = merged["params"]
+                engine.opt_state = merged["opt"]
                 _ = float(scores[0])  # value-read fence
                 recorder.end("comm")
                 n_rounds += 1
             recorder.print_train_info(i)
 
         if data.n_batch_val:
-            # consensus weights = score-weighted average of all workers
-            l, e, e5 = engine.validate(
-                data,
-                params=engine.mean_params(scores),
-                net_state=engine.mean_net_state(scores),
-            )
+            # per-replica validation (reference: each process reports
+            # on its own shard of the val set)
+            l, e, e5 = engine.validate(data)
             recorder.val_error(l, e, e5)
 
         recorder.end_epoch(epoch)
         model.adjust_hyperp(epoch + 1)
         if checkpoint_dir:
-            model.params = engine.mean_params(scores)
-            model.net_state = engine.mean_net_state(scores)
-            model.opt_state = engine.mean_opt_state(scores)
+            _adopt_best(model, engine, scores)
             model.save(checkpoint_dir, recorder)
         model.epoch += 1
 
-    model.params = engine.mean_params(scores)
-    model.net_state = engine.mean_net_state(scores)
-    model.opt_state = engine.mean_opt_state(scores)
+    _adopt_best(model, engine, scores)
 
     last_val = recorder.val_records[-1] if recorder.val_records else {}
     return {

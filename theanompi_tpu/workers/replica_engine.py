@@ -99,12 +99,11 @@ class ReplicaEngine:
 
         net = model.net
         optimizer = model.optimizer
-        cdtype = model.compute_dtype
 
         def local_step(params, net_state, opt_state, x, y, lr, rng):
             def loss_fn(p, s):
                 out, new_s = net.apply(
-                    p, s, x.astype(cdtype), train=True, rng=rng
+                    p, s, model.prep_input(x), train=True, rng=rng
                 )
                 loss = model.compute_loss(out, y)
                 err = 1.0 - accuracy(model.primary_logits(out), y)
@@ -123,7 +122,9 @@ class ReplicaEngine:
         )
 
         def local_val(params, net_state, x, y):
-            out, _ = net.apply(params, net_state, x.astype(cdtype), train=False)
+            out, _ = net.apply(
+                params, net_state, model.prep_input(x), train=False
+            )
             logits = model.primary_logits(out)
             loss = softmax_cross_entropy(logits, y)
             err = 1.0 - accuracy(logits, y)
