@@ -107,8 +107,19 @@ class ImdbData:
 
     def _make_synthetic(self, n: int, seed: int):
         rng = np.random.default_rng(seed)
-        # class lexicons: tokens [10, 110) positive, [110, 210) negative
-        lex = [np.arange(10, 110), np.arange(110, 210)]
+        if self.vocab < 30:
+            raise ValueError(
+                f"synthetic IMDB needs vocab >= 30 (got {self.vocab}): "
+                "ids 0/1 are pad/unk and each class needs a lexicon"
+            )
+        # class lexicons scale with the vocab: two disjoint id ranges
+        # starting at 10 (up to 100 tokens each), e.g. [10, 110)
+        # positive and [110, 210) negative at the default vocab
+        lex_size = min(100, (self.vocab - 10) // 2)
+        lex = [
+            np.arange(10, 10 + lex_size),
+            np.arange(10 + lex_size, 10 + 2 * lex_size),
+        ]
         ys = rng.integers(0, N_CLASSES, n).astype(np.int32)
         xs = np.full((n, self.maxlen), PAD_ID, np.int32)
         lengths = rng.integers(self.maxlen // 4, self.maxlen + 1, n)

@@ -1,0 +1,15 @@
+"""Flax model zoo: third-party-frontend integration.
+
+Reference: ``theanompi/models/lasagne_model_zoo/`` — wrappers giving
+Lasagne-built networks the Theano-MPI model contract, demonstrating
+that any third-party frontend plugs into the workers unchanged
+(SURVEY §2.1).  The TPU-era equivalent frontend is **Flax (linen)**:
+``FlaxClassifier`` adapts any ``flax.linen.Module`` producing logits to
+the contract, so Flax models train under BSP/EASGD/GoSGD exactly like
+the in-tree zoo.
+"""
+
+from theanompi_tpu.models.flax_zoo.adapter import FlaxClassifier, FlaxLayer
+from theanompi_tpu.models.flax_zoo.cnn import FlaxCNN, FlaxResNet18
+
+__all__ = ["FlaxClassifier", "FlaxLayer", "FlaxCNN", "FlaxResNet18"]

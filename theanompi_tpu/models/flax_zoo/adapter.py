@@ -1,0 +1,121 @@
+"""Adapter: any ``flax.linen.Module`` → the Theano-MPI model contract.
+
+Reference: ``theanompi/models/lasagne_model_zoo/`` wrappers, which gave
+Lasagne networks the duck-typed contract the workers drive.  Here ONE
+generic adapter does that for Flax:
+
+- ``FlaxLayer`` maps linen's ``init``/``apply`` (with ``mutable``
+  collections for BN running stats and a ``dropout`` rng) onto the
+  in-tree ``ops.Layer`` protocol, so the standard ``ClassifierModel``
+  compile/step machinery — and therefore every rule and worker — works
+  on Flax params unchanged.
+- ``FlaxClassifier`` is the model class: give it a linen module factory
+  and a data factory, get a contract-conforming model.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from theanompi_tpu.models.base import ClassifierModel
+from theanompi_tpu.models.data.cifar10 import Cifar10Data, SHAPE
+from theanompi_tpu.ops.layers import Layer
+
+PyTree = Any
+
+
+class FlaxLayer(Layer):
+    """Wrap a linen module as an ``ops.Layer``.
+
+    linen state collections (``batch_stats`` etc.) ride in the layer's
+    ``state`` pytree; train-mode calls pass ``mutable`` + a dropout rng
+    the same way the in-tree BN/Dropout layers use ``state``/``rng``.
+    """
+
+    def __init__(self, module, *, train_kwarg: str = "train"):
+        self.module = module
+        self.train_kwarg = train_kwarg
+
+    def init(self, key, in_shape):
+        x = jnp.zeros((1, *in_shape), jnp.float32)
+        p_key, d_key = jax.random.split(key)
+        variables = self.module.init(
+            {"params": p_key, "dropout": d_key},
+            x,
+            **{self.train_kwarg: False},
+        )
+        state = {k: v for k, v in variables.items() if k != "params"}
+        out = jax.eval_shape(
+            lambda v, x: self.module.apply(v, x, **{self.train_kwarg: False}),
+            variables,
+            x,
+        )
+        return variables["params"], state, tuple(out.shape[1:])
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        variables = {"params": params, **state}
+        rngs = {"dropout": rng} if rng is not None else None
+        if train and state:
+            y, new_vars = self.module.apply(
+                variables,
+                x,
+                rngs=rngs,
+                mutable=list(state.keys()),
+                **{self.train_kwarg: True},
+            )
+            return y, dict(new_vars)
+        y = self.module.apply(
+            variables, x, rngs=rngs, **{self.train_kwarg: train}
+        )
+        return y, state
+
+
+class FlaxClassifier(ClassifierModel):
+    """Contract-conforming classifier around a linen module.
+
+    Subclasses (or callers) provide ``module_factory(config) ->
+    linen.Module`` and optionally ``data_factory(config, n_replicas)``
+    (default: CIFAR-10, the Lasagne-zoo's demo dataset scale).
+    """
+
+    def __init__(
+        self,
+        config: dict | None = None,
+        *,
+        module_factory: Callable[[dict], Any] | None = None,
+        data_factory: Callable[[dict, int], Any] | None = None,
+        input_shape: tuple = SHAPE,
+    ):
+        super().__init__(config)
+        if module_factory is not None:
+            self.module_factory = module_factory
+        if data_factory is not None:
+            self.data_factory = data_factory
+        self._input_shape = tuple(input_shape)
+
+    # overridable hooks ---------------------------------------------------
+
+    def module_factory(self, config: dict):
+        raise NotImplementedError(
+            "pass module_factory= or subclass FlaxClassifier"
+        )
+
+    def data_factory(self, config: dict, n_replicas: int):
+        return Cifar10Data(
+            batch_size=config.get("batch_size", 128),
+            n_replicas=n_replicas,
+            seed=self.seed,
+            n_train=config.get("n_train"),
+            n_val=config.get("n_val"),
+        )
+
+    # contract ------------------------------------------------------------
+
+    def build_model(self, n_replicas: int = 1) -> None:
+        self.net = FlaxLayer(self.module_factory(self.config))
+        self.input_shape = self._input_shape
+        self.data = self.data_factory(self.config, n_replicas)
+        self._init_params()

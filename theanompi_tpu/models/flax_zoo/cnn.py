@@ -1,0 +1,87 @@
+"""Concrete Flax-zoo members (reference: the Lasagne zoo shipped VGG,
+ResNet-50 and the LSTM as ready members; these are the Flax-era
+equivalents sized for CIFAR).
+
+``FlaxCNN`` — small conv net (the integration smoke model).
+``FlaxResNet18`` — linen pre-act ResNet-18, the "real model through a
+third-party frontend" demonstration.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from theanompi_tpu.models.data.cifar10 import N_CLASSES
+from theanompi_tpu.models.flax_zoo.adapter import FlaxClassifier
+
+
+class _CNN(nn.Module):
+    n_classes: int = N_CLASSES
+    width: int = 32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for mult in (1, 2):
+            x = nn.Conv(self.width * mult, (3, 3), use_bias=False)(x)
+            x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(0.3, deterministic=not train)(x)
+        return nn.Dense(self.n_classes)(x)
+
+
+class _ResBlock(nn.Module):
+    ch: int
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9)
+        h = norm()(x)
+        h = nn.relu(h)
+        sc = x
+        if self.stride != 1 or x.shape[-1] != self.ch:
+            sc = nn.Conv(self.ch, (1, 1), (self.stride, self.stride),
+                         use_bias=False)(h)
+        h = nn.Conv(self.ch, (3, 3), (self.stride, self.stride),
+                    use_bias=False)(h)
+        h = norm()(h)
+        h = nn.relu(h)
+        h = nn.Conv(self.ch, (3, 3), use_bias=False)(h)
+        return h + sc
+
+
+class _ResNet18(nn.Module):
+    n_classes: int = N_CLASSES
+    stage_sizes: Sequence[int] = (2, 2, 2, 2)
+    width: int = 64
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(self.width, (3, 3), use_bias=False)(x)
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for b in range(n_blocks):
+                x = _ResBlock(
+                    self.width * (2 ** i),
+                    stride=2 if (i > 0 and b == 0) else 1,
+                )(x, train=train)
+        x = nn.relu(nn.BatchNorm(use_running_average=not train,
+                                 momentum=0.9)(x))
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.n_classes)(x)
+
+
+class FlaxCNN(FlaxClassifier):
+    def module_factory(self, config: dict):
+        return _CNN(width=int(config.get("width", 32)))
+
+
+class FlaxResNet18(FlaxClassifier):
+    def module_factory(self, config: dict):
+        return _ResNet18(width=int(config.get("width", 64)))

@@ -209,6 +209,11 @@ def gossip_matrix_round(
     new_scores = kept + recv_score
 
     def merge(p):
+        if not jnp.issubdtype(p.dtype, jnp.floating):
+            # integer leaves (e.g. optimizer step counters) can't be
+            # weight-averaged; workers advance them in lockstep, so
+            # keeping the local value is exact
+            return p
         f32 = p.astype(jnp.float32)
         recv = jnp.tensordot(routing, f32, axes=[[0], [0]])  # [W, ...]
         own = kept.reshape((w,) + (1,) * (f32.ndim - 1)) * f32
