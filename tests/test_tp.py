@@ -1,0 +1,127 @@
+"""Tensor-parallel primitives (`parallel/tp.py`) vs unsharded numpy math.
+
+New-framework scope — SURVEY §2.2 row "Tensor parallel" (absent
+upstream).  Every sharded op is checked against its dense single-device
+equivalent on the virtual 8-device CPU mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from theanompi_tpu.parallel import MODEL_AXIS, make_mesh
+from theanompi_tpu.parallel import tp as tp_lib
+
+
+def tp_mesh(devices8, tp=4):
+    return make_mesh(data=1, model=tp, devices=devices8[:tp])
+
+
+def run_tp(mesh, fn, in_specs, out_specs, *args):
+    return jax.jit(
+        jax.shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    )(*args)
+
+
+class TestShardedMatmuls:
+    def test_col_then_row_equals_dense(self, devices8, rng):
+        mesh = tp_mesh(devices8)
+        x = rng.standard_normal((2, 8, 16)).astype(np.float32)
+        w1 = rng.standard_normal((16, 32)).astype(np.float32)
+        w2 = rng.standard_normal((32, 16)).astype(np.float32)
+
+        def fn(x, w1, w2):
+            h = tp_lib.col_parallel(x, w1)     # [., 32/tp]
+            return tp_lib.row_parallel(h, w2)  # [., 16] replicated
+
+        out = run_tp(
+            mesh, fn,
+            (P(), P(None, MODEL_AXIS), P(MODEL_AXIS, None)), P(),
+            x, w1, w2,
+        )
+        np.testing.assert_allclose(out, (x @ w1) @ w2, rtol=2e-4, atol=2e-4)
+
+
+class TestVocabSharded:
+    VOCAB = 32
+
+    def test_embed_lookup(self, devices8, rng):
+        mesh = tp_mesh(devices8)
+        table = rng.standard_normal((self.VOCAB, 8)).astype(np.float32)
+        ids = rng.integers(0, self.VOCAB, (2, 16)).astype(np.int32)
+
+        out = run_tp(
+            mesh,
+            lambda i, t: tp_lib.embed_lookup(i, t, self.VOCAB),
+            (P(), P(MODEL_AXIS, None)), P(),
+            ids, table,
+        )
+        np.testing.assert_allclose(out, table[ids], rtol=1e-6)
+
+    def test_sharded_xent_matches_dense(self, devices8, rng):
+        mesh = tp_mesh(devices8)
+        logits = rng.standard_normal((4, 6, self.VOCAB)).astype(np.float32)
+        labels = rng.integers(0, self.VOCAB, (4, 6)).astype(np.int32)
+
+        loss = run_tp(
+            mesh,
+            lambda lg, lb: tp_lib.sharded_softmax_xent(lg, lb, self.VOCAB),
+            (P(None, None, MODEL_AXIS), P()), P(),
+            logits, labels,
+        )
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        tgt = np.take_along_axis(logits, labels[..., None], -1)[..., 0]
+        np.testing.assert_allclose(loss, np.mean(lse - tgt), rtol=1e-5)
+
+    def test_sharded_top1_and_topk(self, devices8, rng):
+        mesh = tp_mesh(devices8)
+        logits = rng.standard_normal((4, 6, self.VOCAB)).astype(np.float32)
+        labels = rng.integers(0, self.VOCAB, (4, 6)).astype(np.int32)
+
+        err1, err5 = run_tp(
+            mesh,
+            lambda lg, lb: (
+                tp_lib.sharded_top1_err(lg, lb, self.VOCAB),
+                tp_lib.sharded_topk_err(lg, lb, self.VOCAB, k=5),
+            ),
+            (P(None, None, MODEL_AXIS), P()), (P(), P()),
+            logits, labels,
+        )
+        want1 = np.mean(np.argmax(logits, -1) != labels)
+        top5 = np.argsort(-logits, -1)[..., :5]
+        want5 = 1.0 - np.mean(np.any(top5 == labels[..., None], -1))
+        np.testing.assert_allclose(err1, want1, rtol=1e-6)
+        np.testing.assert_allclose(err5, want5, rtol=1e-6)
+
+
+class TestGradSync:
+    def test_replicated_leaf_averaged_sharded_leaf_untouched(
+        self, devices8
+    ):
+        mesh = make_mesh(data=2, model=2, devices=devices8[:4])
+        specs = {"norm": P(None), "wq": P(None, MODEL_AXIS)}
+
+        def fn():
+            r = lax.axis_index("data").astype(jnp.float32)
+            grads = {
+                "norm": jnp.full((4,), r),        # differs across data
+                "wq": jnp.ones((2, 2)),
+            }
+            return tp_lib.grad_sync(grads, specs)
+
+        out = jax.jit(
+            jax.shard_map(
+                fn, mesh=mesh, in_specs=(),
+                out_specs={"norm": P(None), "wq": P(None, MODEL_AXIS)},
+                check_vma=False,
+            )
+        )()
+        # data ranks held 0 and 1 -> mean 0.5 everywhere
+        np.testing.assert_allclose(out["norm"], 0.5)
+        np.testing.assert_allclose(out["wq"], 1.0)

@@ -71,8 +71,50 @@ class TMModel:
     def val_iter(self, count: int, recorder: Recorder):
         raise NotImplementedError
 
+    # -- schedules (reference: adjust_hyperp per model) -------------------
+
     def adjust_hyperp(self, epoch: int) -> None:
-        pass
+        """Shared lr-schedule knobs: dict {epoch: lr} or 'step' decay.
+        No-op for duck-typed models without a ``config`` dict."""
+        sched = getattr(self, "config", {}).get("lr_schedule")
+        if isinstance(sched, dict) and epoch in sched:
+            self.current_lr = float(sched[epoch])
+        elif sched == "step":
+            every = self.config.get("lr_step_every", 20)
+            gamma = self.config.get("lr_step_gamma", 0.1)
+            self.current_lr = self.config.get("lr", 0.1) * (
+                gamma ** (epoch // every)
+            )
+
+    # -- checkpoint / resume (reference: helper_funcs save/load) ----------
+
+    def checkpoint_trees(self) -> dict[str, PyTree]:
+        """Named pytrees to checkpoint; group names must be attribute
+        names on the model (restore assigns them back via setattr)."""
+        raise NotImplementedError
+
+    def _place_restored(self) -> None:
+        """Hook: re-place restored (host) trees onto the mesh."""
+
+    def save(self, directory: str, recorder: Recorder | None = None) -> None:
+        meta = {"epoch": self.epoch, "lr": self.current_lr}
+        if recorder is not None:
+            meta["recorder"] = recorder.state_dict()
+        save_checkpoint(directory, self.epoch, self.checkpoint_trees(), meta)
+
+    def load(self, directory: str, recorder: Recorder | None = None) -> bool:
+        path = latest_checkpoint(directory)
+        if path is None:
+            return False
+        trees, meta = load_checkpoint(path, self.checkpoint_trees())
+        for group, tree in trees.items():
+            setattr(self, group, tree)
+        self.epoch = int(meta.get("epoch", 0))
+        self.current_lr = float(meta.get("lr", self.current_lr))
+        if recorder is not None and "recorder" in meta:
+            recorder.load_state_dict(meta["recorder"])
+        self._place_restored()
+        return True
 
 
 class ClassifierModel(TMModel):
@@ -276,19 +318,6 @@ class ClassifierModel(TMModel):
         loss, err, err5 = self._val_step(self.params, self.net_state, x, y)
         return float(loss), float(err), float(err5)
 
-    # -- schedules (reference: adjust_hyperp per model) --------------------
-
-    def adjust_hyperp(self, epoch: int) -> None:
-        sched = self.config.get("lr_schedule")
-        if isinstance(sched, dict) and epoch in sched:
-            self.current_lr = float(sched[epoch])
-        elif sched == "step":
-            every = self.config.get("lr_step_every", 20)
-            gamma = self.config.get("lr_step_gamma", 0.1)
-            self.current_lr = self.config.get("lr", 0.1) * (
-                gamma ** (epoch // every)
-            )
-
     # -- checkpoint / resume (reference: helper_funcs save/load) ----------
 
     def checkpoint_trees(self) -> dict[str, PyTree]:
@@ -298,32 +327,9 @@ class ClassifierModel(TMModel):
             "opt_state": self.opt_state,
         }
 
-    def save(self, directory: str, recorder: Recorder | None = None) -> None:
-        meta = {"epoch": self.epoch, "lr": self.current_lr}
-        if recorder is not None:
-            meta["recorder"] = recorder.state_dict()
-        save_checkpoint(directory, self.epoch, self.checkpoint_trees(), meta)
-
-    def load(self, directory: str, recorder: Recorder | None = None) -> bool:
-        path = latest_checkpoint(directory)
-        if path is None:
-            return False
-        trees, meta = load_checkpoint(path, self.checkpoint_trees())
-        self.params = trees["params"]
-        self.net_state = trees["net_state"]
-        self.opt_state = trees["opt_state"]
-        self.epoch = int(meta.get("epoch", 0))
-        self.current_lr = float(meta.get("lr", self.current_lr))
-        if recorder is not None and "recorder" in meta:
-            rec = meta["recorder"]
-            recorder.train_losses = list(rec["train_losses"])
-            recorder.train_errors = list(rec["train_errors"])
-            recorder.val_records = list(rec["val_records"])
-            recorder.epoch_times = list(rec["epoch_times"])
-            recorder.n_iter = int(rec["n_iter"])
+    def _place_restored(self) -> None:
         if self.mesh is not None:
             rep = NamedSharding(self.mesh, P())
             self.params, self.net_state, self.opt_state = jax.device_put(
                 (self.params, self.net_state, self.opt_state), rep
             )
-        return True

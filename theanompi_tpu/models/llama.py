@@ -1,0 +1,396 @@
+"""Llama-family decoder transformer with 3-D parallelism (DP x TP x SP).
+
+New-framework scope: the reference is DP-only (SURVEY §2.2); the
+BASELINE Llama-3-8B stretch config requires tensor parallelism and
+sequence parallelism, which shape this model's design:
+
+- **DP** over the ``data`` mesh axis — batch sharded, grads averaged.
+- **TP** over ``model`` — Megatron-style: QKV/gate/up column-parallel,
+  o/down row-parallel (+psum); vocab sharded through embedding, LM
+  head, and the sharded softmax loss (``parallel/tp.py``) so full
+  logits never materialize.
+- **SP** over ``seq`` — activations sharded on sequence; attention is
+  ``parallel/ring_attention.ring_attention`` (ppermute KV ring).
+
+The WHOLE train step — embed, L layers, loss, backward, optimizer —
+is ONE vma-checked ``shard_map`` under ``jit``: XLA overlaps the TP
+psums and ring hops with compute.  ``check_vma=True`` is load-bearing:
+it makes autodiff insert the exactly-right collective transposes
+(psum↔pvary), so gradients of sharded AND replicated params come back
+correct for any mesh layout with no manual grad reduction (verified by
+the layout-invariance tests).  Per-layer ``jax.checkpoint`` (remat)
+bounds activation memory for long sequences.  Params are initialized
+*under jit with sharded out_shardings*, so the full 8B-scale parameter
+set never materializes on one device.
+
+Architecture per Llama-3: RMSNorm, RoPE, grouped-query attention,
+SwiGLU MLP, untied LM head.  The model satisfies the same worker
+contract as every zoo member, so ``BSP().init(modelfile=
+'theanompi_tpu.models.llama', modelclass='Llama')`` trains it.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from theanompi_tpu.models.base import TMModel
+from theanompi_tpu.models.data.lm_synthetic import MarkovLMData
+from theanompi_tpu.ops import optimizers as opt_lib
+from theanompi_tpu.parallel import DATA_AXIS, MODEL_AXIS, SEQ_AXIS, make_mesh
+from theanompi_tpu.parallel.ring_attention import ring_attention
+from theanompi_tpu.parallel import tp as tp_lib
+from theanompi_tpu.utils import Recorder
+
+PyTree = Any
+
+
+# -- pure model math (runs on LOCAL shards inside shard_map) ----------------
+
+def rms_norm(x, w, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    scale = lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * scale).astype(x.dtype) * w.astype(x.dtype)
+
+
+def rope(x, pos, theta=10000.0):
+    """Rotary embedding. x: [B, H, T, D], pos: [T] global positions."""
+    d = x.shape[-1]
+    inv = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    ang = pos.astype(jnp.float32)[:, None] * inv[None, :]    # [T, D/2]
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    y1 = x1 * cos - x2 * sin
+    y2 = x1 * sin + x2 * cos
+    return jnp.stack([y1, y2], axis=-1).reshape(x.shape).astype(x.dtype)
+
+
+def _heads(x, n, d):
+    """[B, T, n*d] -> [B, n, T, d]"""
+    b, t, _ = x.shape
+    return x.reshape(b, t, n, d).transpose(0, 2, 1, 3)
+
+
+def _unheads(x):
+    """[B, n, T, d] -> [B, T, n*d]"""
+    b, n, t, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b, t, n * d)
+
+
+class Llama(TMModel):
+    """Contract-conforming Llama-style causal LM.
+
+    Config knobs: ``dim, n_layers, n_heads, n_kv_heads, ffn_dim,
+    vocab, seq_len, batch_size, lr, tp, sp, remat, compute_dtype``.
+    ``tp``/``sp`` set the model/seq mesh axis sizes; remaining devices
+    form the data axis.
+    """
+
+    def __init__(self, config: dict | None = None):
+        c = dict(config or {})
+        self.config = c
+        self.dim = int(c.get("dim", 256))
+        self.n_layers = int(c.get("n_layers", 4))
+        self.n_heads = int(c.get("n_heads", 8))
+        self.n_kv_heads = int(c.get("n_kv_heads", self.n_heads))
+        self.ffn_dim = int(c.get("ffn_dim", self.dim * 4))
+        self.vocab = int(c.get("vocab", 256))
+        self.seq_len = int(c.get("seq_len", 256))
+        self.head_dim = self.dim // self.n_heads
+        self.tp = int(c.get("tp", 1))
+        self.sp = int(c.get("sp", 1))
+        self.remat = bool(c.get("remat", True))
+        self.compute_dtype = jnp.dtype(c.get("compute_dtype", "bfloat16"))
+        self.seed = int(c.get("seed", 42))
+        self.n_epochs = int(c.get("n_epochs", 5))
+        self.epoch = 0
+        self.current_lr = float(c.get("lr", 3e-3))
+        self.opt_name = str(c.get("optimizer", "adam"))
+        self.optimizer = opt_lib.get(
+            self.opt_name, weight_decay=float(c.get("weight_decay", 0.0))
+        )
+
+        assert self.dim % self.n_heads == 0
+        assert self.n_heads % self.n_kv_heads == 0, (
+            "n_heads must be a multiple of n_kv_heads (GQA groups)"
+        )
+        assert self.n_heads % self.tp == 0, "n_heads must divide by tp"
+        assert self.n_kv_heads % self.tp == 0, "n_kv_heads must divide by tp"
+        assert self.vocab % self.tp == 0, "vocab must divide by tp"
+        assert self.ffn_dim % self.tp == 0, "ffn_dim must divide by tp"
+        assert self.seq_len % self.sp == 0, "seq_len must divide by sp"
+
+        self.params: PyTree = None
+        self.opt_state: PyTree = None
+        self.mesh: Mesh | None = None
+        self._train_step = None
+        self._val_step = None
+
+    # -- parameter layout -------------------------------------------------
+
+    def param_specs(self) -> PyTree:
+        """PartitionSpec per leaf — the model's sharding contract."""
+        layer = {
+            "attn_norm": P(None),
+            "wq": P(None, MODEL_AXIS),
+            "wk": P(None, MODEL_AXIS),
+            "wv": P(None, MODEL_AXIS),
+            "wo": P(MODEL_AXIS, None),
+            "mlp_norm": P(None),
+            "w_gate": P(None, MODEL_AXIS),
+            "w_up": P(None, MODEL_AXIS),
+            "w_down": P(MODEL_AXIS, None),
+        }
+        return {
+            "embed": P(MODEL_AXIS, None),        # vocab-sharded rows
+            "layers": [dict(layer) for _ in range(self.n_layers)],
+            "final_norm": P(None),
+            "lm_head": P(None, MODEL_AXIS),      # vocab-sharded cols
+        }
+
+    def _init_full_params(self, key) -> PyTree:
+        """Full (unsharded) init; device_put with NamedShardings slices
+        it onto the mesh."""
+        d, f, v = self.dim, self.ffn_dim, self.vocab
+        hd = self.head_dim
+
+        def dense(key, shape, scale=None):
+            scale = scale or (2.0 / (shape[0] + shape[-1])) ** 0.5
+            return scale * jax.random.normal(key, shape, jnp.float32)
+
+        keys = iter(jax.random.split(key, 4 + 9 * self.n_layers))
+        layers = []
+        for _ in range(self.n_layers):
+            layers.append({
+                "attn_norm": jnp.ones((d,)),
+                "wq": dense(next(keys), (d, self.n_heads * hd)),
+                "wk": dense(next(keys), (d, self.n_kv_heads * hd)),
+                "wv": dense(next(keys), (d, self.n_kv_heads * hd)),
+                "wo": dense(next(keys), (self.n_heads * hd, d)),
+                "mlp_norm": jnp.ones((d,)),
+                "w_gate": dense(next(keys), (d, f)),
+                "w_up": dense(next(keys), (d, f)),
+                "w_down": dense(next(keys), (f, d)),
+            })
+            for _ in range(2):
+                next(keys)  # keep key budget aligned (9 per layer)
+        return {
+            "embed": 0.02 * jax.random.normal(next(keys), (v, d), jnp.float32),
+            "layers": layers,
+            "final_norm": jnp.ones((d,)),
+            "lm_head": dense(next(keys), (d, v)),
+        }
+
+    # -- forward (local shards) -------------------------------------------
+
+    def _layer(self, p, x, pos):
+        """One decoder block on local shards: x [B, T_loc, D]."""
+        cdtype = self.compute_dtype
+        h_loc = self.n_heads // self.tp
+        hkv_loc = self.n_kv_heads // self.tp
+        hd = self.head_dim
+
+        xn = rms_norm(x, p["attn_norm"])
+        q = _heads(tp_lib.col_parallel(xn, p["wq"]), h_loc, hd)
+        k = _heads(tp_lib.col_parallel(xn, p["wk"]), hkv_loc, hd)
+        v = _heads(tp_lib.col_parallel(xn, p["wv"]), hkv_loc, hd)
+        q = rope(q, pos)
+        k = rope(k, pos)
+        # GQA: KV stays compact on the ring; folds repeat it locally
+        o = ring_attention(
+            q, k, v, SEQ_AXIS, causal=True, kv_rep=h_loc // hkv_loc
+        )
+        x = x + tp_lib.row_parallel(_unheads(o), p["wo"]).astype(cdtype)
+
+        xn = rms_norm(x, p["mlp_norm"])
+        gate = jax.nn.silu(tp_lib.col_parallel(xn, p["w_gate"]))
+        up = tp_lib.col_parallel(xn, p["w_up"])
+        x = x + tp_lib.row_parallel(gate * up, p["w_down"]).astype(cdtype)
+        return x
+
+    def _forward(self, params, ids):
+        """ids [B_loc, T_loc] -> local vocab-shard logits [.., V/tp]."""
+        cdtype = self.compute_dtype
+        t_loc = ids.shape[1]
+        seq_idx = lax.axis_index(SEQ_AXIS)
+        pos = seq_idx * t_loc + jnp.arange(t_loc)
+
+        x = tp_lib.embed_lookup(ids, params["embed"], self.vocab)
+        x = x.astype(cdtype)
+        layer = self._layer
+        if self.remat:
+            layer = jax.checkpoint(layer)
+        for p in params["layers"]:
+            x = layer(p, x, pos)
+        x = rms_norm(x, params["final_norm"])
+        return tp_lib.col_parallel(x, params["lm_head"]).astype(jnp.float32)
+
+    def _metrics(self, logits_loc, targets, top5: bool = False):
+        """loss/top-1 (+ optional top-5, val-only: its candidate
+        all_gathers are pure overhead on the train hot path)."""
+        loss = tp_lib.sharded_softmax_xent(logits_loc, targets, self.vocab)
+        err = tp_lib.sharded_top1_err(logits_loc, targets, self.vocab)
+        # average over the data/seq shards (each computed a local mean)
+        loss = lax.pmean(loss, (DATA_AXIS, SEQ_AXIS))
+        err = lax.pmean(err, (DATA_AXIS, SEQ_AXIS))
+        if not top5:
+            return loss, err
+        err5 = tp_lib.sharded_topk_err(logits_loc, targets, self.vocab, k=5)
+        # the model-axis pmean is a numerical no-op (every shard holds
+        # the same gathered candidates) but marks err5 vma-invariant
+        err5 = lax.pmean(err5, (DATA_AXIS, SEQ_AXIS, MODEL_AXIS))
+        return loss, err, err5
+
+    # -- contract ---------------------------------------------------------
+
+    def build_model(self, n_replicas: int = 1) -> None:
+        self.data = MarkovLMData(
+            vocab=self.vocab,
+            seq_len=self.seq_len,
+            batch_size=int(self.config.get("batch_size", 8)),
+            n_replicas=n_replicas,
+            n_train=int(self.config.get("n_train", 2048)),
+            n_val=int(self.config.get("n_val", 256)),
+            seed=self.seed,
+        )
+        # params materialize in compile_iter_fns, under jit with sharded
+        # out_shardings — the full tree never lives on one device
+        self.params = None
+        self.opt_state = None
+
+    def compile_iter_fns(self, mesh: Mesh | None = None, **_) -> None:
+        if mesh is None:
+            mesh = make_mesh(model=self.tp, seq=self.sp)
+        self.mesh = mesh
+        assert mesh.shape[MODEL_AXIS] == self.tp, (
+            f"mesh model axis {mesh.shape[MODEL_AXIS]} != tp {self.tp}"
+        )
+        assert mesh.shape[SEQ_AXIS] == self.sp
+
+        specs = self.param_specs()
+        # optimizer-state layout mirrors the params': adam m/v (t is
+        # replicated), momentum velocity; sgd keeps no state
+        if self.opt_name == "adam":
+            opt_specs = {"m": specs, "v": specs, "t": P()}
+        elif self.opt_name == "sgd":
+            opt_specs = ()
+        else:  # momentum / nesterov velocity
+            opt_specs = specs
+        self._specs, self._opt_specs = specs, opt_specs
+        batch_spec = P(DATA_AXIS, SEQ_AXIS)
+        optimizer = self.optimizer
+
+        def step(params, opt_state, x, y, lr):
+            def loss_fn(p):
+                logits = self._forward(p, x)
+                loss, err = self._metrics(logits, y)
+                return loss, err
+
+            # check_vma=True autodiff returns exact grads for every
+            # layout — no grad_sync / manual reduction (module docstring)
+            (loss, err), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params)
+            params, opt_state = optimizer.update(params, grads, opt_state, lr)
+            return params, opt_state, loss, err
+
+        def val(params, x, y):
+            logits = self._forward(params, x)
+            return self._metrics(logits, y, top5=True)
+
+        self._train_step = jax.jit(
+            jax.shard_map(
+                step,
+                mesh=mesh,
+                in_specs=(specs, opt_specs, batch_spec, batch_spec, P()),
+                out_specs=(specs, opt_specs, P(), P()),
+            ),
+            donate_argnums=(0, 1),
+        )
+        self._val_step = jax.jit(
+            jax.shard_map(
+                val,
+                mesh=mesh,
+                in_specs=(specs, batch_spec, batch_spec),
+                out_specs=(P(), P(), P()),
+            )
+        )
+
+        if self.params is None:
+            # sharded init: jit + out_shardings lets GSPMD partition the
+            # RNG and slice each param straight onto its mesh shards
+            shardings = jax.tree.map(
+                lambda s: NamedSharding(mesh, s), specs,
+                is_leaf=lambda x: isinstance(x, P),
+            )
+            opt_shardings = jax.tree.map(
+                lambda s: NamedSharding(mesh, s), opt_specs,
+                is_leaf=lambda x: isinstance(x, P),
+            )
+
+            def init(key):
+                params = self._init_full_params(key)
+                return params, self.optimizer.init(params)
+
+            self.params, self.opt_state = jax.jit(
+                init, out_shardings=(shardings, opt_shardings)
+            )(jax.random.PRNGKey(self.seed))
+        self._batch_sharding = NamedSharding(mesh, batch_spec)
+
+    def put_batch(self, batch):
+        x, y = batch
+        return (
+            jax.device_put(jnp.asarray(x, jnp.int32), self._batch_sharding),
+            jax.device_put(jnp.asarray(y, jnp.int32), self._batch_sharding),
+        )
+
+    @property
+    def train_step_fn(self):
+        return self._train_step
+
+    def train_iter(self, count: int, recorder: Recorder) -> None:
+        recorder.start()
+        x, y = self.put_batch(self.data.train_batch(count))
+        recorder.end("wait")
+        recorder.start()
+        self.params, self.opt_state, loss, err = self._train_step(
+            self.params, self.opt_state, x, y, jnp.float32(self.current_lr)
+        )
+        loss_v, err_v = float(loss), float(err)   # value-read fence
+        recorder.end("calc")
+        recorder.train_error(count, loss_v, err_v)
+
+    def val_iter(self, count: int, recorder: Recorder):
+        x, y = self.put_batch(self.data.val_batch(count))
+        loss, err, err5 = self._val_step(self.params, x, y)
+        return float(loss), float(err), float(err5)
+
+    # -- checkpoint (save/load/adjust_hyperp inherited from TMModel) ------
+
+    def checkpoint_trees(self) -> dict[str, PyTree]:
+        return {"params": self.params, "opt_state": self.opt_state}
+
+    def _place_restored(self) -> None:
+        if self.mesh is None:
+            return
+
+        def put(tree, spec_tree):
+            return jax.tree.map(
+                lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
+                tree, spec_tree,
+            )
+
+        self.params = put(self.params, self._specs)
+        self.opt_state = put(self.opt_state, self._opt_specs)
+
+
+# Llama-3-8B shape (the BASELINE stretch config), for reference and
+# bench configs; smoke tests use much smaller dims.
+LLAMA3_8B = dict(
+    dim=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+    ffn_dim=14336, vocab=128256, seq_len=8192,
+)

@@ -1,0 +1,273 @@
+"""Attention primitives: reference MHA, blockwise online-softmax
+update, and a Pallas TPU flash-attention kernel.
+
+New-framework scope (the reference has no attention at all — SURVEY
+§2.2 lists ring attention / blockwise as absent upstream, to be built
+for the long-context configs).  Design:
+
+- ``mha_reference`` — plain jnp softmax attention; the numerical
+  ground truth for every other path and the CPU fallback.
+- ``block_attn_update`` — ONE step of the online-softmax recurrence
+  (Milakov & Gimelshein 2018; the flash-attention accumulator): takes
+  the running ``(acc, m, l)`` carry and folds in one KV block.  Both
+  the ring-attention loop (``parallel/ring_attention.py``) and any
+  sequential blockwise scan share this exact function, so cross-device
+  ring results match single-device attention bit-for-bit in fp32.
+- ``flash_attention`` — fused Pallas kernel (grid over heads × query
+  blocks, KV streamed through VMEM, f32 accumulators in scratch) with
+  the same signature; falls back to ``mha_reference`` off-TPU.
+
+Shapes follow [B, H, T, D] (head-major, the TPU-friendly layout: the
+``[Tq, D] x [D, Tk]`` score matmul and ``[Tq, Tk] x [Tk, D]`` value
+matmul both hit the MXU per (batch, head) grid cell).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30  # finite "-inf": keeps exp() NaN-free in masked blocks
+
+
+def causal_mask(q_pos: jnp.ndarray, k_pos: jnp.ndarray) -> jnp.ndarray:
+    """[Tq, Tk] bool — query may attend to keys at <= its position."""
+    return q_pos[:, None] >= k_pos[None, :]
+
+
+def mha_reference(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = True,
+    q_offset: int | jnp.ndarray = 0,
+    k_offset: int | jnp.ndarray = 0,
+    sm_scale: float | None = None,
+) -> jnp.ndarray:
+    """Dense softmax attention, f32 softmax.  q,k,v: [B, H, T, D].
+
+    ``q_offset``/``k_offset`` are the *global* positions of element 0,
+    so sharded callers can mask correctly on local blocks.
+    """
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * sm_scale
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[2])
+        k_pos = k_offset + jnp.arange(k.shape[2])
+        s = jnp.where(causal_mask(q_pos, k_pos), s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+
+
+def block_attn_update(
+    carry: tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray],
+    q: jnp.ndarray,
+    k_blk: jnp.ndarray,
+    v_blk: jnp.ndarray,
+    *,
+    q_pos: jnp.ndarray | None,
+    k_pos: jnp.ndarray | None,
+    sm_scale: float,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Fold one KV block into the online-softmax carry.
+
+    carry = (acc [B,H,Tq,D] f32, m [B,H,Tq] f32 running max,
+    l [B,H,Tq] f32 running sum).  Pass ``q_pos``/``k_pos`` (global
+    positions) for causal masking, or None for full attention.
+    """
+    acc, m, l = carry
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk).astype(jnp.float32)
+    s = s * sm_scale
+    if q_pos is not None:
+        mask = causal_mask(q_pos, k_pos)
+        s = jnp.where(mask, s, NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    # exp of masked entries: s=NEG_INF, m_new >= old max; use explicit
+    # where so fully-masked blocks contribute exact zeros
+    p = jnp.exp(s - m_new[..., None])
+    if q_pos is not None:
+        p = jnp.where(mask, p, 0.0)
+    alpha = jnp.exp(m - m_new)
+    l_new = alpha * l + jnp.sum(p, axis=-1)
+    acc_new = acc * alpha[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p.astype(v_blk.dtype), v_blk
+    ).astype(jnp.float32)
+    return acc_new, m_new, l_new
+
+
+def block_attn_init(b, h, tq, d):
+    """Fresh online-softmax carry."""
+    return (
+        jnp.zeros((b, h, tq, d), jnp.float32),
+        jnp.full((b, h, tq), NEG_INF, jnp.float32),
+        jnp.zeros((b, h, tq), jnp.float32),
+    )
+
+
+def block_attn_finish(carry, dtype):
+    acc, _, l = carry
+    return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU flash attention
+# ---------------------------------------------------------------------------
+
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *, sm_scale, causal
+):
+    """One (batch*head, q-block, kv-block) grid cell.
+
+    The kv grid dim is sequential (``ARBITRARY`` semantics), so only a
+    ``block_k`` KV slice is VMEM-resident at a time — VMEM stays
+    O(block_q*d + block_k*d) however long the context — and the
+    online-softmax carry lives in VMEM scratch across kv steps.
+    """
+    ki = pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0]                                   # [block_q, d]
+    block_q, d = q.shape
+    block_k = k_ref.shape[1]
+    q_start = pl.program_id(1) * block_q
+    k_start = ki * block_k
+
+    # causal: blocks fully above the diagonal fold in nothing
+    needed = (not causal) or (q_start + block_q > k_start)
+
+    @pl.when(needed)
+    def _fold():
+        k_blk = k_ref[0]
+        v_blk = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale                               # [block_q, block_k]
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            mask = q_pos >= k_pos
+            s = jnp.where(mask, s, NEG_INF)
+        m, l = m_ref[...], l_ref[...]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        alpha = jnp.exp(m - m_new)
+        pv = jax.lax.dot_general(
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[...] = m_new
+        l_ref[...] = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + pv
+
+    @pl.when(ki == n_k - 1)
+    def _finish():
+        o_ref[0] = (
+            acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+try:  # pallas imports fail gracefully on backends without Mosaic
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAVE_PALLAS = True
+except ImportError:  # pragma: no cover
+    _HAVE_PALLAS = False
+
+
+def _on_tpu(x) -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "sm_scale", "block_q", "block_k", "interpret"),
+)
+def flash_attention_tpu(
+    q, k, v, *, causal=True, sm_scale=None, block_q=256, block_k=256,
+    interpret=False,
+):
+    """Fused flash attention.  q,k,v: [B, H, T, D]; T (and T_k) must be
+    divisible by the block sizes — ``flash_attention`` dispatches away
+    otherwise.  ``interpret=True`` runs the kernel in the Pallas
+    interpreter (any backend; how the tests exercise it)."""
+    b, h, t, d = q.shape
+    t_k = k.shape[2]
+    if sm_scale is None:
+        sm_scale = d**-0.5
+    block_q = min(block_q, t)
+    block_k = min(block_k, t_k)
+    if t % block_q or t_k % block_k:
+        raise ValueError(
+            f"T={t}/T_k={t_k} not divisible by blocks ({block_q},{block_k})"
+        )
+
+    grid = (b * h, t // block_q, t_k // block_k)
+    qs = q.reshape(b * h, t, d)
+    ks = k.reshape(b * h, t_k, d)
+    vs = v.reshape(b * h, t_k, d)
+
+    kernel = functools.partial(
+        _flash_kernel, sm_scale=sm_scale, causal=causal
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=(
+                pltpu.GridDimensionSemantics.PARALLEL,
+                pltpu.GridDimensionSemantics.PARALLEL,
+                # kv dim carries the scratch accumulator -> sequential
+                pltpu.GridDimensionSemantics.ARBITRARY,
+            ),
+        ),
+        interpret=interpret,
+    )(qs, ks, vs)
+    return out.reshape(b, h, t, d)
+
+
+def flash_attention(q, k, v, *, causal=True, sm_scale=None):
+    """Dispatch: Pallas kernel on TPU (shapes permitting), reference
+    math elsewhere.
+
+    The forward-only kernel is used where no gradient flows (e.g.
+    inference/validation); training paths currently differentiate the
+    reference/blockwise form, whose VJP XLA generates.
+    """
+    t, t_k = q.shape[2], k.shape[2]
+    divisible = t % min(256, t) == 0 and t_k % min(256, t_k) == 0
+    if _HAVE_PALLAS and _on_tpu(q) and divisible:
+        return flash_attention_tpu(q, k, v, causal=causal, sm_scale=sm_scale)
+    return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)

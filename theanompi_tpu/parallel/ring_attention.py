@@ -1,0 +1,111 @@
+"""Ring attention: sequence/context parallelism over the ``seq`` mesh
+axis (new-framework scope — SURVEY §2.2 row "Ring attention", absent
+upstream; the TPU-native answer to long-context training).
+
+Each device holds a contiguous sequence shard of Q, K, V.  The KV pair
+rotates around the ring (one ``lax.ppermute`` neighbor hop per step —
+nearest-neighbour ICI traffic, the pattern the TPU torus is built
+for), while every device folds the visiting KV block into its local
+queries' online-softmax carry (``ops.attention.block_attn_update`` —
+the same accumulator flash attention uses, so the distributed result
+equals single-device attention in fp32).
+
+XLA overlaps the next ppermute with the current block's compute
+(they're independent in the dataflow graph), which is the
+communication-hiding property the ring schedule exists for
+(Liu et al. 2023, Ring Attention with Blockwise Transformers).
+
+Causality: block pairs are masked by *global* positions.  A fully
+future KV block still costs one rotation hop (the ring must complete)
+but its scores are masked; the per-block einsums remain static-shaped,
+which is what keeps the whole loop one compiled XLA program.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from theanompi_tpu.ops.attention import (
+    block_attn_finish,
+    block_attn_init,
+    block_attn_update,
+)
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    *,
+    causal: bool = True,
+    sm_scale: float | None = None,
+    kv_rep: int = 1,
+) -> jnp.ndarray:
+    """Attention over a sequence sharded on ``axis_name``.
+
+    Must be called inside ``shard_map``; q,k,v are the LOCAL shards
+    [B, H, T_loc, D] (sequence dim pre-sharded).  Returns the local
+    output shard [B, H, T_loc, D].
+
+    ``kv_rep`` > 1 is GQA: K/V carry H/kv_rep heads and circulate the
+    ring in that compact form (the expensive part — ppermute bytes on
+    the ICI seq axis); each fold repeats the *visiting* block up to H
+    heads locally, which is free relative to the hop it avoids fattening.
+    """
+    b, h, t_loc, d = q.shape
+    if sm_scale is None:
+        sm_scale = d**-0.5
+    s_size = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    q_pos = my_idx * t_loc + jnp.arange(t_loc) if causal else None
+    perm = [(i, (i + 1) % s_size) for i in range(s_size)]
+
+    def body(step, carry):
+        acc_m_l, k_cur, v_cur = carry
+        # the block visiting us at `step` started at device my_idx-step
+        src = (my_idx - step) % s_size
+        k_pos = src * t_loc + jnp.arange(k_cur.shape[2]) if causal else None
+        k_use, v_use = (
+            (jnp.repeat(k_cur, kv_rep, axis=1),
+             jnp.repeat(v_cur, kv_rep, axis=1))
+            if kv_rep != 1 else (k_cur, v_cur)
+        )
+        acc_m_l = block_attn_update(
+            acc_m_l, q, k_use, v_use,
+            q_pos=q_pos, k_pos=k_pos, sm_scale=sm_scale,
+        )
+        if step == s_size - 1:  # last fold: no hop left to feed
+            return acc_m_l, k_cur, v_cur
+        # rotate compact KV to the next device
+        k_nxt, v_nxt = jax.tree.map(
+            lambda x: lax.ppermute(x, axis_name, perm), (k_cur, v_cur)
+        )
+        return acc_m_l, k_nxt, v_nxt
+
+    carry = (block_attn_init(b, h, t_loc, d), k, v)
+    # unrolled python loop: s_size is static and small; lets XLA
+    # overlap each hop's ppermute with the next block's matmuls
+    for step in range(s_size):
+        carry = body(step, carry)
+    return block_attn_finish(carry[0], q.dtype)
+
+
+def ring_attention_sharded(
+    q, k, v, mesh, axis_name: str = "seq", *, causal: bool = True
+):
+    """Convenience wrapper: shard_map ``ring_attention`` alone over
+    ``mesh`` for [B, H, T, D] inputs sharded on T (testing/standalone
+    use; models call ``ring_attention`` inside their own shard_map)."""
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(None, None, axis_name, None)
+    fn = partial(ring_attention, axis_name=axis_name, causal=causal)
+    return jax.jit(
+        jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                      out_specs=spec)
+    )(q, k, v)

@@ -1,0 +1,162 @@
+"""Tensor-parallel building blocks over the ``model`` mesh axis
+(new-framework scope — SURVEY §2.2 row "Tensor parallel": absent
+upstream, required for the Llama-class configs).
+
+Megatron-style decomposition expressed as pure functions inside
+``shard_map``: column-parallel matmuls need no communication (the
+activation picks up a sharded feature dim), row-parallel matmuls end
+in one ``psum`` over the model axis — which XLA lowers onto ICI.  The
+vocab dimension (embedding table + LM head + softmax loss) is sharded
+the same way, with the masked-gather / global-logsumexp tricks that
+keep the full [B, T, V] logits from ever materializing on one chip.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from theanompi_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+
+PyTree = jax.typing.ArrayLike | dict | list | tuple
+
+
+# -- sharded matmuls --------------------------------------------------------
+
+def col_parallel(x, w, axis_name: str = MODEL_AXIS):
+    """[..., D] x [D, F/tp] -> [..., F/tp]; no comm (output sharded)."""
+    del axis_name
+    return x @ w.astype(x.dtype)
+
+
+def row_parallel(x, w, axis_name: str = MODEL_AXIS):
+    """[..., F/tp] x [F/tp, D] -> [..., D] via partial matmul + psum."""
+    return lax.psum(x @ w.astype(x.dtype), axis_name)
+
+
+# -- vocab-sharded embedding ------------------------------------------------
+
+def vocab_shard_info(vocab: int, axis_name: str = MODEL_AXIS):
+    tp = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    v_loc = vocab // tp
+    return v_loc, idx * v_loc
+
+
+def embed_lookup(ids, table, vocab: int, axis_name: str = MODEL_AXIS):
+    """Row-sharded embedding: each shard owns ids [off, off+V/tp);
+    misses contribute zeros and one psum assembles full vectors."""
+    v_loc, off = vocab_shard_info(vocab, axis_name)
+    local = ids - off
+    hit = (local >= 0) & (local < v_loc)
+    safe = jnp.clip(local, 0, v_loc - 1)
+    vecs = table[safe] * hit[..., None].astype(table.dtype)
+    return lax.psum(vecs, axis_name)
+
+
+# -- vocab-sharded softmax cross-entropy ------------------------------------
+
+def sharded_softmax_xent(
+    logits_loc, labels, vocab: int, axis_name: str = MODEL_AXIS
+):
+    """Mean CE over tokens with the vocab dim sharded.
+
+    logits_loc: [..., V/tp] local shard (f32 recommended);
+    labels: [...] int32 global ids.  Never materializes full logits:
+    global logsumexp = max-psum + sum-psum, target logit = masked
+    gather + psum.
+    """
+    v_loc, off = vocab_shard_info(vocab, axis_name)
+    x = logits_loc.astype(jnp.float32)
+
+    # stability shift only — constant wrt the gradient (pmax has no
+    # JVP rule, so it must see a zero-tangent operand); d(lse)/dx is
+    # still the softmax
+    m = lax.pmax(lax.stop_gradient(jnp.max(x, axis=-1)), axis_name)
+    lse = m + jnp.log(
+        lax.psum(jnp.sum(jnp.exp(x - m[..., None]), axis=-1), axis_name)
+    )
+
+    local = labels - off
+    hit = (local >= 0) & (local < v_loc)
+    safe = jnp.clip(local, 0, v_loc - 1)
+    tgt = jnp.take_along_axis(x, safe[..., None], axis=-1)[..., 0]
+    tgt = lax.psum(jnp.where(hit, tgt, 0.0), axis_name)
+    return jnp.mean(lse - tgt)
+
+
+def sharded_top1_err(logits_loc, labels, vocab: int,
+                     axis_name: str = MODEL_AXIS):
+    """Top-1 error with sharded vocab: global argmax via the
+    (value, id) max-reduction trick."""
+    v_loc, off = vocab_shard_info(vocab, axis_name)
+    # metrics carry no gradient; keeps pmax/pmin off the JVP path
+    x = lax.stop_gradient(logits_loc).astype(jnp.float32)
+    loc_max = jnp.max(x, axis=-1)
+    loc_arg = jnp.argmax(x, axis=-1) + off
+    gmax = lax.pmax(loc_max, axis_name)
+    # lowest global id among tying shards wins (deterministic)
+    cand = jnp.where(loc_max >= gmax, loc_arg, vocab)
+    pred = lax.pmin(cand, axis_name)
+    return jnp.mean((pred != labels).astype(jnp.float32))
+
+
+def sharded_topk_err(logits_loc, labels, vocab: int, k: int = 5,
+                     axis_name: str = MODEL_AXIS):
+    """Top-k error with sharded vocab: local top-k candidates,
+    all_gather the (tp*k_loc) candidates, global top-k among them.
+
+    Exact even when a shard holds fewer than ``k`` entries: any global
+    top-k element is in its own shard's local top-min(k, v_loc), so the
+    gathered candidate set always contains the true top-k.
+    """
+    v_loc, off = vocab_shard_info(vocab, axis_name)
+    k_loc = min(k, v_loc)
+    x = lax.stop_gradient(logits_loc).astype(jnp.float32)
+    vals, ids = lax.top_k(x, k_loc)                               # [..., k_loc]
+    ids = ids + off
+    all_vals = lax.all_gather(vals, axis_name, axis=-1, tiled=True)
+    all_ids = lax.all_gather(ids, axis_name, axis=-1, tiled=True)
+    k_eff = min(k, all_vals.shape[-1])
+    _, sel = lax.top_k(all_vals, k_eff)
+    top_ids = jnp.take_along_axis(all_ids, sel, axis=-1)
+    hit = jnp.any(top_ids == labels[..., None], axis=-1)
+    return jnp.mean(1.0 - hit.astype(jnp.float32))
+
+
+# -- spec-aware gradient reduction ------------------------------------------
+
+def grad_sync(grads: PyTree, specs: PyTree,
+              mesh_axes=(DATA_AXIS, MODEL_AXIS, SEQ_AXIS)) -> PyTree:
+    """Mean-reduce each grad leaf over every mesh axis its param is
+    REPLICATED on (the axes absent from its PartitionSpec).
+
+    ONLY for explicitly-constructed per-shard grads (manual backward,
+    or pure-DP forwards with no collectives, under ``check_vma=False``)
+    — the generalized BSP exchanger.  Do NOT apply it to autodiff grads
+    from a vma-checked (``check_vma=True``) shard_map: there the
+    psum↔pvary transposes already deliver exact grads for every layout
+    and a further psum would double-count (see models/llama.py).
+    """
+
+    def one(g, spec):
+        used = set()
+        for entry in tuple(spec):
+            if entry is None:
+                continue
+            if isinstance(entry, (tuple, list)):
+                used.update(entry)
+            else:
+                used.add(entry)
+        reduce_over = tuple(a for a in mesh_axes if a not in used)
+        if not reduce_over:
+            return g
+        n = 1
+        for a in reduce_over:
+            n *= lax.axis_size(a)
+        return (lax.psum(g.astype(jnp.float32), reduce_over) / n).astype(
+            g.dtype
+        )
+
+    return jax.tree.map(one, grads, specs)

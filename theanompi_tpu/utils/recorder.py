@@ -152,10 +152,12 @@ class Recorder:
     def save(self, path: str | Path) -> None:
         Path(path).write_text(json.dumps(self.state_dict()))
 
-    def load(self, path: str | Path) -> None:
-        d = json.loads(Path(path).read_text())
+    def load_state_dict(self, d: dict) -> None:
         self.train_losses = list(d["train_losses"])
         self.train_errors = list(d["train_errors"])
         self.val_records = list(d["val_records"])
         self.epoch_times = list(d["epoch_times"])
         self.n_iter = int(d["n_iter"])
+
+    def load(self, path: str | Path) -> None:
+        self.load_state_dict(json.loads(Path(path).read_text()))
