@@ -7,12 +7,18 @@ per worker (``MPI.COMM_SELF.Spawn``) doing load → random crop +
 horizontal flip − mean → deliver into a shared GPU buffer, overlapping
 I/O/augmentation with compute (pipeline depth 1).
 
-TPU-native rebuild: pre-batched ``.npz`` shard files (one file per
-global batch: ``x`` uint8 [B, H, W, 3], ``y`` int32 [B]) under
-``$TM_DATA_DIR/imagenet_batches/{train,val}/``, shuffled file list per
-epoch, and a **background prefetch thread** per controller replacing
-the MPI-spawned loader process: it reads + augments the next
-``depth`` batches into a bounded queue while the devices compute.
+TPU-native rebuild: pre-batched shard files (one file per global
+batch: ``x`` uint8 [B, H, W, 3], ``y`` int32 [B]) under
+``$TM_DATA_DIR/imagenet_batches/{train,val}/`` in either format —
+``.tmb`` (raw, mmap-friendly; see ``theanompi_tpu/native``) or
+``.npz`` — with a shuffled file list per epoch.  The MPI-spawned
+loader process is replaced by one of two async producers:
+
+- **native** (preferred, ``.tmb`` + compiled ``loader.cc``): a C++
+  worker pool doing read → random crop + hflip − mean → ordered
+  bounded ring, entirely off the GIL;
+- **thread** fallback: a background Python prefetch thread.
+
 The augmentation (random 224 crop from 256 + hflip − mean) matches the
 reference's loader.  Synthetic fallback when no files exist.
 """
@@ -87,15 +93,20 @@ class ImageNetData:
         self._seed = seed
         self._epoch = 0
         self._prefetch: _PrefetchThread | None = None
+        self._prefetch_pos = -1  # no prefetch in flight until shuffle()
+        self._native = None  # None=untried, False=unavailable, else loader
 
         root = Path(os.environ.get("TM_DATA_DIR", "/data"))
         bdir = root / "imagenet_batches"
-        self._train_files: list[Path] = (
-            sorted((bdir / "train").glob("*.npz")) if bdir.is_dir() else []
-        )
-        self._val_files: list[Path] = (
-            sorted((bdir / "val").glob("*.npz")) if bdir.is_dir() else []
-        )
+
+        def find(split: str) -> list[Path]:
+            if not bdir.is_dir():
+                return []
+            tmb = sorted((bdir / split).glob("*.tmb"))
+            return tmb or sorted((bdir / split).glob("*.npz"))
+
+        self._train_files: list[Path] = find("train")
+        self._val_files: list[Path] = find("val")
         self.synthetic = not self._train_files
 
         if self.synthetic:
@@ -166,19 +177,64 @@ class ImageNetData:
                 f"re-shard the files (write_batch_files) or fix batch_size"
             )
 
+    @staticmethod
+    def _read_file(f: Path) -> tuple[np.ndarray, np.ndarray]:
+        if f.suffix == ".tmb":
+            from theanompi_tpu.native import read_tmb
+
+            return read_tmb(f)
+        with np.load(f) as z:
+            return z["x"], z["y"].astype(np.int32)
+
     def _load_train(self, i: int):
         f = self._train_files[self._file_perm[i % len(self._file_perm)]]
-        with np.load(f) as z:
-            x = z["x"].astype(np.float32)
-            y = z["y"].astype(np.int32)
+        x, y = self._read_file(f)
+        x = np.asarray(x, np.float32)
         self._check_batch(x, f)
         x = self._augment(x, self._seed * 7 + self._epoch * 65537 + i)
-        return x, y
+        return x, np.asarray(y, np.int32)
 
     # -- async prefetch (proc_load_mpi equivalent) ------------------------
 
+    def _native_loader(self):
+        """Build (once) the C++ loader over .tmb files, or None."""
+        if self._native is False:
+            return None
+        if self._native is None:
+            self._native = False
+            if self._train_files[0].suffix == ".tmb":
+                try:
+                    from theanompi_tpu.native import NativeBatchLoader
+
+                    loader = NativeBatchLoader(
+                        self._train_files,
+                        crop=self.crop,
+                        mean=self._center_mean()[0],
+                        depth=self.prefetch_depth,
+                        n_threads=int(os.environ.get("TM_LOADER_THREADS", 4)),
+                        seed=self._seed,
+                    )
+                    # same contract as _check_batch on the other paths
+                    if loader.batch_shape[0] != self.global_batch:
+                        raise ValueError(
+                            f"pre-batched files hold "
+                            f"{loader.batch_shape[0]} images but the "
+                            f"configured global batch is "
+                            f"{self.global_batch}; re-shard the files "
+                            f"(write_batch_files) or fix batch_size"
+                        )
+                    self._native = loader
+                except (RuntimeError, OSError):
+                    pass  # no toolchain: thread fallback
+        return self._native or None
+
     def start_prefetch(self, epoch: int) -> None:
         if self.synthetic:
+            return
+        native = self._native_loader()
+        if native is not None:
+            native.set_epoch(epoch, np.asarray(self._file_perm, np.int32))
+            self._prefetch_pos = 0
             return
         if self._prefetch is not None:
             self._prefetch.stop()
@@ -191,6 +247,10 @@ class ImageNetData:
     def train_batch(self, i: int):
         if self.synthetic:
             return self._syn.train_batch(i)
+        native = self._native_loader()
+        if native is not None and self._prefetch_pos == i:
+            self._prefetch_pos += 1
+            return native.next()
         if self._prefetch is not None and self._prefetch_pos == i:
             self._prefetch_pos += 1
             return self._prefetch.get()
@@ -199,9 +259,9 @@ class ImageNetData:
     def val_batch(self, i: int):
         if self.synthetic:
             return self._syn.val_batch(i)
-        with np.load(self._val_files[i]) as z:
-            x = z["x"].astype(np.float32)
-            y = z["y"].astype(np.int32)
+        x, y = self._read_file(self._val_files[i])
+        x = np.asarray(x, np.float32)
+        y = np.asarray(y, np.int32)
         self._check_batch(x, self._val_files[i])
         c = self.crop
         off_h = (x.shape[1] - c) // 2
@@ -216,17 +276,24 @@ def write_batch_files(
     labels: np.ndarray,
     global_batch: int,
     split: str = "train",
+    fmt: str = "tmb",
 ) -> int:
-    """Utility: shard (images, labels) into the pre-batched ``.npz``
-    format this pipeline reads (the reference shipped separate scripts
-    to hickle-ify raw ImageNet; this is the rebuild's equivalent)."""
+    """Utility: shard (images, labels) into the pre-batched format this
+    pipeline reads — ``tmb`` (raw, feeds the native loader) or ``npz``
+    (the reference shipped separate scripts to hickle-ify raw ImageNet;
+    this is the rebuild's equivalent)."""
     out = Path(out_dir) / "imagenet_batches" / split
     out.mkdir(parents=True, exist_ok=True)
     n = (len(labels) // global_batch) * global_batch
     for b, start in enumerate(range(0, n, global_batch)):
-        np.savez(
-            out / f"batch_{b:06d}.npz",
-            x=images[start : start + global_batch],
-            y=labels[start : start + global_batch],
-        )
+        x = images[start : start + global_batch]
+        y = labels[start : start + global_batch]
+        if fmt == "tmb":
+            from theanompi_tpu.native import write_tmb
+
+            write_tmb(out / f"batch_{b:06d}.tmb", x, y)
+        elif fmt == "npz":
+            np.savez(out / f"batch_{b:06d}.npz", x=x, y=y)
+        else:
+            raise ValueError(f"unknown fmt {fmt!r}; use 'tmb' or 'npz'")
     return n // global_batch

@@ -1,0 +1,212 @@
+"""Native (C++) runtime components + ctypes bindings.
+
+The reference's native surface lived in its dependencies (libhdf5 for
+hickle batch files, Open MPI for the spawned loader process — SURVEY
+§2.3).  The rebuild keeps the TPU compute path in XLA/Pallas and puts
+the *runtime around it* in-tree C++: this package holds the loader
+engine (``loader.cc``) and compiles it on demand with the system g++
+(pybind11 isn't in this image; the ABI is plain C + ctypes).
+
+``load_native()`` returns the bound library or None — every consumer
+has a pure-Python fallback, so a missing toolchain degrades gracefully.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+
+import numpy as np
+
+_HERE = Path(__file__).resolve().parent
+_SRC = _HERE / "loader.cc"
+_LIB = _HERE / "_tm_native.so"
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    """(Re)compile the shared library if the source is newer."""
+    if _LIB.exists() and _LIB.stat().st_mtime >= _SRC.stat().st_mtime:
+        return True
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        str(_SRC), "-o", str(_LIB),
+    ]
+    try:
+        subprocess.run(
+            cmd, check=True, capture_output=True, text=True, timeout=300
+        )
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def load_native() -> ctypes.CDLL | None:
+    """Compile (if needed) and bind the native library; None on failure."""
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("TM_NATIVE", "1") == "0" or not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(str(_LIB))
+        except OSError:
+            # stale/foreign-arch artifact: force one rebuild, then give up
+            try:
+                _LIB.unlink()
+            except OSError:
+                return None
+            if not _build():
+                return None
+            try:
+                lib = ctypes.CDLL(str(_LIB))
+            except OSError:
+                return None
+        lib.tm_loader_open.restype = ctypes.c_void_p
+        lib.tm_loader_open.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_uint64,
+            np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+            ctypes.c_int,
+        ]
+        lib.tm_loader_set_epoch.argtypes = [
+            ctypes.c_void_p, ctypes.c_int,
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            ctypes.c_int,
+        ]
+        lib.tm_loader_next.restype = ctypes.c_int
+        lib.tm_loader_next.argtypes = [
+            ctypes.c_void_p,
+            np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        ]
+        lib.tm_loader_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+class NativeBatchLoader:
+    """Ordered, multithreaded batch loader over ``.tmb`` files.
+
+    Drop-in producer for the data pipeline's prefetch slot: call
+    ``set_epoch(epoch, perm)`` then ``next()`` exactly once per batch
+    in order.  Augmentation (random crop + hflip − mean) runs in the
+    C++ worker pool, deterministic per (seed, epoch, position).
+    """
+
+    def __init__(
+        self,
+        files: list[str | Path],
+        crop: int,
+        mean: np.ndarray,
+        *,
+        depth: int = 4,
+        n_threads: int = 4,
+        seed: int = 0,
+    ):
+        lib = load_native()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        paths = [str(f).encode() for f in files]
+        blob = b"\x00".join(paths) + b"\x00"
+        # probe channel count from the first header to size the mean
+        with open(files[0], "rb") as f:
+            head = f.read(20)
+        if head[:4] != b"TMB1":
+            raise ValueError(f"{files[0]} is not a TMB1 batch file")
+        n, h, w, c = np.frombuffer(head[4:], np.int32)
+        mean_full = np.ascontiguousarray(
+            np.broadcast_to(
+                mean.reshape(mean.shape[-3:]) if mean.ndim >= 3 else mean,
+                (crop, crop, c),
+            ),
+            np.float32,
+        )
+        self._h = lib.tm_loader_open(
+            blob, len(paths), crop, depth, n_threads,
+            ctypes.c_uint64(seed), mean_full, mean_full.size,
+        )
+        if not self._h:
+            raise ValueError(
+                "tm_loader_open failed: inconsistent/corrupt .tmb files "
+                f"or bad crop {crop} for {h}x{w} images"
+            )
+        self.batch_shape = (int(n), crop, crop, int(c))
+
+    def set_epoch(self, epoch: int, perm: np.ndarray | None = None) -> None:
+        if perm is None:
+            perm = np.empty(0, np.int32)
+        perm = np.ascontiguousarray(perm, np.int32)
+        self._lib.tm_loader_set_epoch(self._h, epoch, perm, perm.size)
+
+    def next(self) -> tuple[np.ndarray, np.ndarray]:
+        n, cr, _, c = self.batch_shape
+        x = np.empty((n, cr, cr, c), np.float32)
+        y = np.empty((n,), np.int32)
+        rc = self._lib.tm_loader_next(self._h, x, y)
+        if rc == 1:
+            raise StopIteration("epoch exhausted")
+        if rc != 0:
+            raise IOError("native loader failed reading a batch file")
+        return x, y
+
+    def close(self) -> None:
+        if getattr(self, "_h", None):
+            self._lib.tm_loader_close(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+# -- .tmb format helpers (shared with the pure-Python fallback path) --------
+
+def write_tmb(path: str | Path, x: np.ndarray, y: np.ndarray) -> None:
+    """Write one raw batch file: x uint8 [N,H,W,C], y int32 [N].
+
+    Non-uint8 pixels must be losslessly representable as uint8 —
+    silently truncating pre-normalized floats would train on garbage.
+    """
+    if np.asarray(x).dtype != np.uint8:
+        xf = np.asarray(x)
+        if xf.min() < 0 or xf.max() > 255 or not np.array_equal(
+            xf, np.floor(xf)
+        ):
+            raise ValueError(
+                ".tmb stores uint8 pixels; got non-integral or out-of-"
+                "range values — pass raw [0,255] images (or use fmt='npz')"
+            )
+    x = np.ascontiguousarray(x, np.uint8)
+    y = np.ascontiguousarray(y, np.int32)
+    assert x.ndim == 4 and y.shape == (x.shape[0],), (x.shape, y.shape)
+    with open(path, "wb") as f:
+        f.write(b"TMB1")
+        f.write(np.asarray(x.shape, np.int32).tobytes())
+        f.write(y.tobytes())
+        f.write(x.tobytes())
+
+
+def read_tmb(path: str | Path) -> tuple[np.ndarray, np.ndarray]:
+    """Pure-numpy reader (memory-mapped pixels)."""
+    with open(path, "rb") as f:
+        head = f.read(20)
+    if head[:4] != b"TMB1":
+        raise ValueError(f"{path} is not a TMB1 batch file")
+    n, h, w, c = (int(v) for v in np.frombuffer(head[4:], np.int32))
+    y = np.fromfile(path, np.int32, count=n, offset=20)
+    x = np.memmap(
+        path, np.uint8, mode="r", offset=20 + 4 * n, shape=(n, h, w, c)
+    )
+    return x, y

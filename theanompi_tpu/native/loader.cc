@@ -1,0 +1,258 @@
+// Native batch-file loader engine (C++17, no deps beyond the STL).
+//
+// The reference's async input pipeline is a spawned MPI loader process
+// per worker (theanompi/models/data/proc_load_mpi.py: recv filename ->
+// hickle.load -> random crop + horizontal flip - mean -> shared GPU
+// buffer handshake).  The TPU rebuild replaces hickle/HDF5 (C library
+// libhdf5) and the MPI-spawned process with this in-tree C++ engine:
+//
+//   * .tmb batch files — raw, mmap-friendly:
+//       [0:4)   magic "TMB1"
+//       [4:20)  int32 n, h, w, c   (little-endian)
+//       [20:20+4n)            int32 labels
+//       [20+4n: ... )         uint8 pixels, NHWC
+//   * a pool of worker threads, each: pread the file, random-crop +
+//     hflip + mean-subtract into float32 NHWC, deterministic per
+//     (seed, epoch, position) whatever thread runs it;
+//   * a bounded in-order delivery ring (depth slots of backpressure),
+//     consumer side blocks in tm_next until the next sequence number
+//     is ready.
+//
+// Exposed as a tiny C ABI consumed via ctypes (theanompi_tpu/native/
+// __init__.py) — no pybind11 dependency in this image.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Header {
+  int32_t n, h, w, c;
+};
+
+struct Batch {
+  std::vector<float> x;
+  std::vector<int32_t> y;
+};
+
+bool read_header(const std::string& path, Header* out) {
+  FILE* f = std::fopen(path.c_str(), "rb");
+  if (!f) return false;
+  char magic[4];
+  bool ok = std::fread(magic, 1, 4, f) == 4 &&
+            std::memcmp(magic, "TMB1", 4) == 0 &&
+            std::fread(out, sizeof(int32_t), 4, f) == 4;
+  std::fclose(f);
+  return ok && out->n > 0 && out->h > 0 && out->w > 0 && out->c > 0;
+}
+
+class Loader {
+ public:
+  Loader(std::vector<std::string> files, Header hdr, int crop, int depth,
+         int n_threads, uint64_t seed, std::vector<float> mean)
+      : files_(std::move(files)),
+        hdr_(hdr),
+        crop_(crop),
+        depth_(depth < 1 ? 1 : depth),
+        seed_(seed),
+        mean_(std::move(mean)) {
+    order_.resize(files_.size());
+    for (size_t i = 0; i < order_.size(); ++i) order_[i] = (int)i;
+    for (int t = 0; t < n_threads; ++t)
+      workers_.emplace_back([this] { worker(); });
+  }
+
+  ~Loader() {
+    {
+      std::lock_guard<std::mutex> l(m_);
+      stop_ = true;
+    }
+    cv_work_.notify_all();
+    cv_ready_.notify_all();
+    for (auto& w : workers_) w.join();
+  }
+
+  void set_epoch(int epoch, const int32_t* perm, int n) {
+    std::lock_guard<std::mutex> l(m_);
+    if (perm && n > 0) order_.assign(perm, perm + n);
+    epoch_ = epoch;
+    ++generation_;
+    next_claim_ = 0;
+    next_deliver_ = 0;
+    ready_.clear();
+    failed_ = false;  // a past transient error doesn't poison new epochs
+    cv_work_.notify_all();
+  }
+
+  // Blocks until the next in-order batch is ready; copies it out.
+  // Returns 0 on success, 1 past end-of-epoch, 2 on file error.
+  int next(float* x_out, int32_t* y_out) {
+    std::unique_lock<std::mutex> l(m_);
+    if (next_deliver_ >= (long)order_.size()) return 1;
+    long want = next_deliver_;
+    cv_ready_.wait(l, [&] {
+      return stop_ || failed_ || ready_.count(want) != 0;
+    });
+    if (stop_) return 1;
+    if (failed_ && ready_.count(want) == 0) return 2;
+    Batch b = std::move(ready_[want]);
+    ready_.erase(want);
+    ++next_deliver_;
+    cv_work_.notify_all();
+    l.unlock();
+    std::memcpy(x_out, b.x.data(), b.x.size() * sizeof(float));
+    std::memcpy(y_out, b.y.data(), b.y.size() * sizeof(int32_t));
+    return 0;
+  }
+
+ private:
+  void worker() {
+    for (;;) {
+      long seq;
+      long gen;
+      int file_idx, epoch;
+      {
+        std::unique_lock<std::mutex> l(m_);
+        cv_work_.wait(l, [&] {
+          return stop_ || (next_claim_ < (long)order_.size() &&
+                           next_claim_ - next_deliver_ < depth_);
+        });
+        if (stop_) return;
+        gen = generation_;
+        seq = next_claim_++;
+        // copy under the lock: set_epoch may reassign order_/epoch_
+        file_idx = order_[seq];
+        epoch = epoch_;
+      }
+      Batch b;
+      bool ok = process(file_idx, epoch, seq, &b);
+      {
+        std::lock_guard<std::mutex> l(m_);
+        if (gen != generation_) continue;  // stale epoch: drop
+        if (!ok) {
+          failed_ = true;
+        } else {
+          ready_[seq] = std::move(b);
+        }
+      }
+      cv_ready_.notify_all();
+    }
+  }
+
+  bool process(int file_idx, int epoch, long seq, Batch* out) {
+    const Header& h = hdr_;
+    const size_t n_px = (size_t)h.n * h.h * h.w * h.c;
+    std::vector<int32_t> labels(h.n);
+    std::vector<uint8_t> px(n_px);
+    {
+      FILE* f = std::fopen(files_[file_idx].c_str(), "rb");
+      if (!f) return false;
+      bool ok = std::fseek(f, 20, SEEK_SET) == 0 &&
+                std::fread(labels.data(), sizeof(int32_t), h.n, f) ==
+                    (size_t)h.n &&
+                std::fread(px.data(), 1, n_px, f) == n_px;
+      std::fclose(f);
+      if (!ok) return false;
+    }
+
+    // deterministic per (seed, epoch, position-in-epoch)
+    std::mt19937_64 rng(seed_ ^ (0x9e3779b97f4a7c15ULL * (uint64_t)epoch) ^
+                        ((uint64_t)seq << 20));
+    const int cr = crop_;
+    std::uniform_int_distribution<int> di(0, h.h - cr);
+    std::uniform_int_distribution<int> dj(0, h.w - cr);
+    std::uniform_int_distribution<int> dflip(0, 1);
+
+    out->x.resize((size_t)h.n * cr * cr * h.c);
+    out->y = std::move(labels);
+    // mean_ is always a full [cr, cr, c] image (Python broadcasts
+    // per-channel / scalar means before the call)
+    for (int k = 0; k < h.n; ++k) {
+      const int i0 = di(rng), j0 = dj(rng);
+      const bool flip = dflip(rng) != 0;
+      const uint8_t* src = px.data() + (size_t)k * h.h * h.w * h.c;
+      float* dst = out->x.data() + (size_t)k * cr * cr * h.c;
+      for (int i = 0; i < cr; ++i) {
+        const uint8_t* row = src + ((size_t)(i0 + i) * h.w + j0) * h.c;
+        float* drow = dst + (size_t)i * cr * h.c;
+        const float* mrow = mean_.data() + (size_t)i * cr * h.c;
+        for (int j = 0; j < cr; ++j) {
+          const uint8_t* p = row + (size_t)(flip ? cr - 1 - j : j) * h.c;
+          float* d = drow + (size_t)j * h.c;
+          const float* mp = mrow + (size_t)j * h.c;
+          for (int ch = 0; ch < h.c; ++ch)
+            d[ch] = (float)p[ch] - mp[ch];
+        }
+      }
+    }
+    return true;
+  }
+
+  std::vector<std::string> files_;
+  Header hdr_;
+  int crop_, depth_;
+  uint64_t seed_;
+  std::vector<float> mean_;
+
+  std::mutex m_;
+  std::condition_variable cv_work_, cv_ready_;
+  std::vector<std::thread> workers_;
+  std::vector<int> order_;
+  std::map<long, Batch> ready_;
+  long next_claim_ = 0, next_deliver_ = 0, generation_ = 0;
+  int epoch_ = 0;
+  bool stop_ = false, failed_ = false;
+};
+
+}  // namespace
+
+extern "C" {
+
+// Opens a loader over n_files .tmb paths (NUL-separated blob).  mean
+// must be crop*crop*c floats (a full mean image; caller broadcasts).
+// Returns nullptr if any header is unreadable or inconsistent.
+void* tm_loader_open(const char* paths_blob, int n_files, int crop,
+                     int depth, int n_threads, uint64_t seed,
+                     const float* mean, int mean_len) {
+  std::vector<std::string> files;
+  const char* p = paths_blob;
+  for (int i = 0; i < n_files; ++i) {
+    files.emplace_back(p);
+    p += files.back().size() + 1;
+  }
+  if (files.empty()) return nullptr;
+  Header hdr;
+  if (!read_header(files[0], &hdr)) return nullptr;
+  for (size_t i = 1; i < files.size(); ++i) {
+    Header h2;
+    if (!read_header(files[i], &h2) || std::memcmp(&h2, &hdr, sizeof(hdr)))
+      return nullptr;
+  }
+  if (crop <= 0 || crop > hdr.h || crop > hdr.w) return nullptr;
+  if (mean_len != crop * crop * hdr.c) return nullptr;
+  std::vector<float> m(mean, mean + mean_len);
+  return new Loader(std::move(files), hdr, crop, depth,
+                    n_threads < 1 ? 1 : n_threads, seed, std::move(m));
+}
+
+void tm_loader_set_epoch(void* handle, int epoch, const int32_t* perm,
+                         int n) {
+  static_cast<Loader*>(handle)->set_epoch(epoch, perm, n);
+}
+
+int tm_loader_next(void* handle, float* x_out, int32_t* y_out) {
+  return static_cast<Loader*>(handle)->next(x_out, y_out);
+}
+
+void tm_loader_close(void* handle) { delete static_cast<Loader*>(handle); }
+
+}  // extern "C"
