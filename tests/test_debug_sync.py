@@ -1,0 +1,64 @@
+"""Debug-mode replica-sync checks (SURVEY §5.2 rebuild)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from theanompi_tpu.parallel import make_mesh
+from theanompi_tpu.parallel.debug import (
+    check_replicas_synced,
+    replica_buffer_spread,
+)
+
+
+class TestBufferSpread:
+    def test_zero_for_replicated_tree(self, mesh8):
+        rep = NamedSharding(mesh8, P())
+        tree = {
+            "a": jax.device_put(jnp.arange(16.0), rep),
+            "b": jax.device_put(jnp.ones((4, 4)), rep),
+        }
+        assert replica_buffer_spread(tree) == 0.0
+        assert check_replicas_synced(tree) == 0.0
+
+    def test_detects_desync(self, devices8):
+        # forge a "replicated" array whose device copies disagree by
+        # building it from per-device shards
+        mesh = make_mesh(data=2, devices=devices8[:2])
+        rep = NamedSharding(mesh, P())
+        copies = [
+            jax.device_put(jnp.zeros(8), devices8[0]),
+            jax.device_put(jnp.full((8,), 0.5), devices8[1]),
+        ]
+        bad = jax.make_array_from_single_device_arrays(
+            (8,), rep, copies
+        )
+        spread = replica_buffer_spread({"w": bad})
+        assert spread == pytest.approx(0.5)
+        with pytest.raises(RuntimeError, match="replica desync"):
+            check_replicas_synced({"w": bad})
+
+    def test_sharded_leaves_ignored(self, mesh8):
+        dp = NamedSharding(mesh8, P("data"))
+        tree = {"x": jax.device_put(jnp.arange(16.0), dp)}
+        assert replica_buffer_spread(tree) == 0.0
+
+
+class TestWorkerIntegration:
+    def test_bsp_epoch_check_passes(self, devices8, monkeypatch):
+        from theanompi_tpu.workers import bsp_worker
+
+        monkeypatch.setenv("TM_DEBUG_SYNC", "1")
+        out = bsp_worker.run(
+            devices=devices8[:2],
+            modelfile="theanompi_tpu.models.wresnet",
+            modelclass="WResNet",
+            config={
+                "batch_size": 4, "n_epochs": 1, "depth": 10, "widen": 1,
+                "n_train": 16, "n_val": 8,
+            },
+            verbose=False,
+        )
+        assert out["epochs"] == 1

@@ -44,14 +44,21 @@ class TestLayoutInvariance:
         assert np.isclose(e1, e8, rtol=1e-4), (e1, e8)
         assert np.isclose(e5_1, e5_8, rtol=1e-4), (e5_1, e5_8)
 
-    def test_sgd_training_matches_across_meshes(self, devices8):
+    @pytest.mark.parametrize("sp_mode", ["ring", "ulysses"])
+    def test_sgd_training_matches_across_meshes(self, devices8, sp_mode):
         """SGD training curves must coincide on 1x1x1 and 2x2x2 — this
         catches any layout-dependent gradient scaling (unlike Adam,
         SGD is not invariant to per-leaf grad rescaling)."""
-        m1 = build(devices8, data=1, tp=1, sp=1, optimizer="sgd", lr=0.5)
+        # ulysses needs (heads/tp) % sp == 0, so widen the head config
+        heads = (
+            dict(n_heads=8, n_kv_heads=4) if sp_mode == "ulysses" else {}
+        )
+        m1 = build(
+            devices8, data=1, tp=1, sp=1, optimizer="sgd", lr=0.5, **heads
+        )
         m8 = build(
             devices8, data=2, tp=2, sp=2, batch_size=2,
-            optimizer="sgd", lr=0.5,
+            optimizer="sgd", lr=0.5, sp_mode=sp_mode, **heads,
         )
         r1, r8 = Recorder(rank=0), Recorder(rank=0)
         for i in range(4):

@@ -1,9 +1,10 @@
-"""Ring attention + blockwise/flash primitives vs dense reference.
+"""Ring + Ulysses attention and blockwise/flash primitives vs dense
+reference.
 
-New-framework scope — SURVEY §2.2 rows "Ring attention / blockwise"
-and "Sequence/context parallel" (absent upstream).  The sharded ring
-result must match single-device dense attention because both reduce
-through the same online-softmax accumulator.
+New-framework scope — SURVEY §2.2 rows "Ring attention / blockwise",
+"Ulysses (attention head all-to-all)" and "Sequence/context parallel"
+(all absent upstream).  Every sharded path must match single-device
+dense attention.
 """
 
 import jax
@@ -74,6 +75,58 @@ class TestFlashKernel:
             flash_attention_tpu(
                 q, k, v, block_q=16, block_k=16, interpret=True
             )
+
+
+class TestUlysses:
+    @pytest.mark.parametrize("n_seq", [2, 4])
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_dense(self, devices8, rng, n_seq, causal):
+        from theanompi_tpu.parallel.ulysses import ulysses_attention_sharded
+
+        mesh = make_mesh(data=1, seq=n_seq, devices=devices8[:n_seq])
+        q, k, v = qkv(rng)
+        out = ulysses_attention_sharded(q, k, v, mesh, causal=causal)
+        want = mha_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(want), rtol=2e-5, atol=2e-5
+        )
+
+    def test_gqa_compact_kv(self, devices8, rng):
+        from functools import partial
+
+        from jax.sharding import PartitionSpec as P
+
+        from theanompi_tpu.parallel.ulysses import ulysses_attention
+
+        n_seq, rep = 2, 2
+        mesh = make_mesh(data=1, seq=n_seq, devices=devices8[:n_seq])
+        q = jnp.asarray(rng.standard_normal((B, H, T, D)), jnp.float32)
+        kv_shape = (B, H // rep, T, D)
+        k = jnp.asarray(rng.standard_normal(kv_shape), jnp.float32)
+        v = jnp.asarray(rng.standard_normal(kv_shape), jnp.float32)
+        spec = P(None, None, "seq", None)
+        out = jax.jit(
+            jax.shard_map(
+                partial(ulysses_attention, axis_name="seq", causal=True,
+                        kv_rep=rep),
+                mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            )
+        )(q, k, v)
+        want = mha_reference(
+            q, jnp.repeat(k, rep, axis=1), jnp.repeat(v, rep, axis=1),
+            causal=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(want), rtol=2e-5, atol=2e-5
+        )
+
+    def test_rejects_indivisible_heads(self, devices8, rng):
+        from theanompi_tpu.parallel.ulysses import ulysses_attention_sharded
+
+        mesh = make_mesh(data=1, seq=8, devices=devices8)
+        q, k, v = qkv(rng)  # H=4 < sp=8
+        with pytest.raises(ValueError, match="divisible"):
+            ulysses_attention_sharded(q, k, v, mesh)
 
 
 class TestRing:

@@ -10,7 +10,9 @@ sequence parallelism, which shape this model's design:
   head, and the sharded softmax loss (``parallel/tp.py``) so full
   logits never materialize.
 - **SP** over ``seq`` — activations sharded on sequence; attention is
-  ``parallel/ring_attention.ring_attention`` (ppermute KV ring).
+  either ``parallel/ring_attention`` (ppermute KV ring, the default)
+  or ``parallel/ulysses`` (head all-to-all), selected by the
+  ``sp_mode`` config knob.
 
 The WHOLE train step — embed, L layers, loss, backward, optimizer —
 is ONE vma-checked ``shard_map`` under ``jit``: XLA overlaps the TP
@@ -43,6 +45,7 @@ from theanompi_tpu.models.data.lm_synthetic import MarkovLMData
 from theanompi_tpu.ops import optimizers as opt_lib
 from theanompi_tpu.parallel import DATA_AXIS, MODEL_AXIS, SEQ_AXIS, make_mesh
 from theanompi_tpu.parallel.ring_attention import ring_attention
+from theanompi_tpu.parallel.ulysses import ulysses_attention
 from theanompi_tpu.parallel import tp as tp_lib
 from theanompi_tpu.utils import Recorder
 
@@ -103,6 +106,7 @@ class Llama(TMModel):
         self.head_dim = self.dim // self.n_heads
         self.tp = int(c.get("tp", 1))
         self.sp = int(c.get("sp", 1))
+        self.sp_mode = str(c.get("sp_mode", "ring"))
         self.remat = bool(c.get("remat", True))
         self.compute_dtype = jnp.dtype(c.get("compute_dtype", "bfloat16"))
         self.seed = int(c.get("seed", 42))
@@ -123,6 +127,14 @@ class Llama(TMModel):
         assert self.vocab % self.tp == 0, "vocab must divide by tp"
         assert self.ffn_dim % self.tp == 0, "ffn_dim must divide by tp"
         assert self.seq_len % self.sp == 0, "seq_len must divide by sp"
+        assert self.sp_mode in ("ring", "ulysses"), self.sp_mode
+        if self.sp_mode == "ulysses":
+            h_loc = self.n_heads // self.tp
+            hkv_loc = self.n_kv_heads // self.tp
+            assert h_loc % self.sp == 0 and hkv_loc % self.sp == 0, (
+                f"ulysses needs per-TP-shard heads divisible by sp: "
+                f"H/tp={h_loc}, Hkv/tp={hkv_loc}, sp={self.sp}"
+            )
 
         self.params: PyTree = None
         self.opt_state: PyTree = None
@@ -200,10 +212,9 @@ class Llama(TMModel):
         v = _heads(tp_lib.col_parallel(xn, p["wv"]), hkv_loc, hd)
         q = rope(q, pos)
         k = rope(k, pos)
-        # GQA: KV stays compact on the ring; folds repeat it locally
-        o = ring_attention(
-            q, k, v, SEQ_AXIS, causal=True, kv_rep=h_loc // hkv_loc
-        )
+        # GQA: KV stays compact on the wire; repeated only at compute
+        attn = ring_attention if self.sp_mode == "ring" else ulysses_attention
+        o = attn(q, k, v, SEQ_AXIS, causal=True, kv_rep=h_loc // hkv_loc)
         x = x + tp_lib.row_parallel(_unheads(o), p["wo"]).astype(cdtype)
 
         xn = rms_norm(x, p["mlp_norm"])

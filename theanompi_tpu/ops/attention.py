@@ -192,7 +192,19 @@ except ImportError:  # pragma: no cover
     _HAVE_PALLAS = False
 
 
-def _on_tpu(x) -> bool:
+def _on_tpu(x=None) -> bool:
+    """True when the framework is executing on the TPU backend.
+
+    ``TM_TPU_PLATFORM`` (the framework's device-discovery override —
+    the test suite sets it to ``cpu`` to use the virtual host mesh even
+    though a TPU backend is registered) takes precedence over JAX's
+    default backend, which would otherwise claim 'tpu' for CPU meshes.
+    """
+    import os
+
+    plat = os.environ.get("TM_TPU_PLATFORM")
+    if plat:
+        return plat == "tpu"
     try:
         return jax.default_backend() == "tpu"
     except Exception:  # pragma: no cover
@@ -230,9 +242,12 @@ def flash_attention_tpu(
     kernel = functools.partial(
         _flash_kernel, sm_scale=sm_scale, causal=causal
     )
+    # propagate vma so the kernel composes with vma-checked shard_map
     out = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct(
+            (b * h, t, d), q.dtype, vma=jax.typeof(qs).vma
+        ),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),

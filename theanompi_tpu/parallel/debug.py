@@ -1,0 +1,64 @@
+"""Debug-mode consistency checks (SURVEY §5.2).
+
+The reference had no race detection; exchange correctness rested on
+MPI message ordering.  XLA's deterministic collectives remove most of
+that risk by construction, so the rebuild's debug mode checks the one
+thing construction can't: that the bytes on the chips actually agree.
+
+- ``replica_buffer_spread`` — host-side: pulls every per-device copy
+  of each (fully or partially) replicated leaf and returns the worst
+  absolute disagreement.  Nonzero means a broken collective, a missed
+  donation, or silent data corruption.
+- ``replica_consistency_delta`` (in ``parallel/exchange``) — in-graph:
+  max |local − pmean| inside a shard_map, the cheap psum-style assert
+  for replicated state.
+
+Workers enable the epoch-end check with ``TM_DEBUG_SYNC=1``; it raises
+on any nonzero spread (``check_replicas_synced(strict=False)`` instead
+returns the spread for callers that want to log it).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+
+PyTree = Any
+
+
+def replica_buffer_spread(tree: PyTree) -> float:
+    """Worst |copy_i − copy_j| over all device copies of every leaf.
+
+    Shards holding the same array index are replicas and must be
+    bitwise equal; leaves without replication contribute nothing.
+    """
+    worst = 0.0
+    for leaf in jax.tree.leaves(tree):
+        if not isinstance(leaf, jax.Array) or leaf.size == 0:
+            continue
+        by_index: dict[str, list] = {}
+        for s in leaf.addressable_shards:
+            by_index.setdefault(repr(s.index), []).append(s)
+        for copies in by_index.values():
+            if len(copies) < 2:
+                continue
+            ref = np.asarray(copies[0].data, np.float64)
+            for other in copies[1:]:
+                d = np.abs(ref - np.asarray(other.data, np.float64)).max()
+                worst = max(worst, float(d))
+    return worst
+
+
+def check_replicas_synced(
+    tree: PyTree, *, strict: bool = True, label: str = "params"
+) -> float:
+    """Assert (or report) that replicated device copies agree."""
+    spread = replica_buffer_spread(tree)
+    if spread > 0.0 and strict:
+        raise RuntimeError(
+            f"replica desync in {label}: device copies differ by "
+            f"{spread:g} — broken collective or memory corruption"
+        )
+    return spread

@@ -1,0 +1,97 @@
+"""Ulysses sequence parallelism: attention-head all-to-all over the
+``seq`` mesh axis (new-framework scope — SURVEY §2.2 row "Ulysses
+(attention head all-to-all)", absent upstream).
+
+Where ring attention keeps queries resident and rotates KV around the
+ring (sp-1 ppermute hops), Ulysses re-shards ONCE each way: an
+all_to_all turns the [B, H, T/sp, D] sequence shard into a
+[B, H/sp, T, D] head shard, every device runs ordinary full-sequence
+attention for its heads (the flash kernel's home turf — one dense
+local problem, no per-hop accumulator), and a second all_to_all
+restores sequence sharding.  Two collectives per attention call vs the
+ring's sp-1: better when sp is large and H is divisible; the ring wins
+when heads are scarce (GQA KV already compact) or sequence shards are
+too big to gather.  Both are exposed so configs can pick per model
+(``sp_mode`` knob in models/llama.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from theanompi_tpu.ops.attention import flash_attention, mha_reference
+
+
+def heads_to_seq(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    """[B, H, T_loc, D] seq-shard -> [B, H/sp, T, D] head-shard."""
+    return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+def seq_to_heads(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    """[B, H/sp, T, D] head-shard -> [B, H, T_loc, D] seq-shard."""
+    return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                          tiled=True)
+
+
+def ulysses_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    *,
+    causal: bool = True,
+    sm_scale: float | None = None,
+    kv_rep: int = 1,
+    use_flash: bool = False,
+) -> jnp.ndarray:
+    """Attention over a sequence sharded on ``axis_name``.
+
+    Must be called inside ``shard_map``; q,k,v are LOCAL sequence
+    shards [B, H, T_loc, D] (KV may carry H/kv_rep heads — GQA stays
+    compact through the all_to_all and is repeated only for the local
+    compute).  Requires H (and H/kv_rep) divisible by the axis size.
+    Returns the local output shard [B, H, T_loc, D].
+
+    ``use_flash=False`` (default) computes the local attention in the
+    differentiable dense form — REQUIRED under ``jax.grad``, because
+    the Pallas flash kernel is forward-only; pass ``use_flash=True``
+    only on inference/validation paths.
+    """
+    sp = lax.axis_size(axis_name)
+    h = q.shape[1]
+    hkv = k.shape[1]
+    if h % sp or hkv % sp:
+        raise ValueError(
+            f"ulysses needs heads divisible by the seq axis: "
+            f"H={h}, H_kv={hkv}, sp={sp} (use sp_mode='ring' instead)"
+        )
+    qh = heads_to_seq(q, axis_name)          # [B, H/sp, T, D]
+    kh = heads_to_seq(k, axis_name)          # [B, Hkv/sp, T, D]
+    vh = heads_to_seq(v, axis_name)
+    if kv_rep != 1:
+        kh = jnp.repeat(kh, kv_rep, axis=1)
+        vh = jnp.repeat(vh, kv_rep, axis=1)
+    attn = flash_attention if use_flash else mha_reference
+    oh = attn(qh, kh, vh, causal=causal, sm_scale=sm_scale)
+    return seq_to_heads(oh, axis_name)       # [B, H, T_loc, D]
+
+
+def ulysses_attention_sharded(
+    q, k, v, mesh, axis_name: str = "seq", *, causal: bool = True
+):
+    """Convenience wrapper: shard_map ``ulysses_attention`` alone over
+    ``mesh`` for [B, H, T, D] inputs sharded on T (testing/standalone
+    use; models call ``ulysses_attention`` inside their own shard_map)."""
+    from functools import partial
+
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(None, None, axis_name, None)
+    fn = partial(ulysses_attention, axis_name=axis_name, causal=causal)
+    return jax.jit(
+        jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                      out_specs=spec)
+    )(q, k, v)

@@ -13,6 +13,7 @@ overlaps the collective with backprop, which the reference could not.
 from __future__ import annotations
 
 import importlib
+import os
 from typing import Any, Sequence
 
 from theanompi_tpu import launcher as _launcher
@@ -99,6 +100,15 @@ def run(
             recorder.val_error(tot_l / nv, tot_e / nv, tot_e5 / nv)
 
         recorder.end_epoch(epoch)
+        if os.environ.get("TM_DEBUG_SYNC") == "1":
+            # SURVEY §5.2 debug mode: the chips must hold identical
+            # replicated params after a full epoch of exchanges
+            from theanompi_tpu.parallel.debug import check_replicas_synced
+
+            spread = check_replicas_synced(model.params, strict=True)
+            if verbose:
+                print(f"debug-sync epoch {epoch}: spread={spread:g}",
+                      flush=True)
         model.adjust_hyperp(epoch + 1)
         if checkpoint_dir:
             model.save(checkpoint_dir, recorder)
