@@ -117,8 +117,35 @@ def block_attn_finish(carry, dtype):
 # Pallas TPU flash attention
 # ---------------------------------------------------------------------------
 
+def _block_causal_mask(q_start, k_start, block_q, block_k):
+    """[block_q, block_k] bool mask from global block offsets."""
+    q_pos = q_start + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    k_pos = k_start + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    return q_pos >= k_pos
+
+
+def _recompute_p(q, k, lse, q_start, k_start, sm_scale, causal):
+    """Backward-pass recompute of the normalized softmax block:
+    p = exp(s − lse) with the causal mask re-applied."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * sm_scale                                  # [block_q, block_k]
+    p = jnp.exp(s - lse)
+    if causal:
+        p = jnp.where(
+            _block_causal_mask(q_start, k_start, *p.shape), p, 0.0
+        )
+    return p
+
+
 def _flash_kernel(
-    q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *, sm_scale, causal
+    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+    *, sm_scale, causal
 ):
     """One (batch*head, q-block, kv-block) grid cell.
 
@@ -154,13 +181,7 @@ def _flash_kernel(
             preferred_element_type=jnp.float32,
         ) * sm_scale                               # [block_q, block_k]
         if causal:
-            q_pos = q_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = k_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            mask = q_pos >= k_pos
+            mask = _block_causal_mask(q_start, k_start, block_q, block_k)
             s = jnp.where(mask, s, NEG_INF)
         m, l = m_ref[...], l_ref[...]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
@@ -181,6 +202,10 @@ def _flash_kernel(
         o_ref[0] = (
             acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)
         ).astype(o_ref.dtype)
+        # logsumexp per query row — the backward kernels' residual
+        # (kept [block_q, 1]: Mosaic wants block dims (8k, 128k)-
+        # aligned or full, and a trailing singleton is always full)
+        lse_ref[0] = m_ref[...] + jnp.log(jnp.maximum(l_ref[...], 1e-30))
 
 
 try:  # pallas imports fail gracefully on backends without Mosaic
@@ -211,6 +236,233 @@ def _on_tpu(x=None) -> bool:
         return False
 
 
+def _flash_bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc, *, sm_scale, causal
+):
+    """dK/dV for one kv block: grid (bh, kv-block, q-block), the q dim
+    sequential so the [block_k, d] accumulators live in scratch."""
+    qi = pl.program_id(2)
+    n_q = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    block_q = q_ref.shape[1]
+    block_k = k_ref.shape[1]
+    q_start = qi * block_q
+    k_start = pl.program_id(1) * block_k
+
+    # causal: a kv block whose keys are all in this q block's future
+    # contributes nothing to these dK/dV rows
+    needed = (not causal) or (q_start + block_q > k_start)
+
+    @pl.when(needed)
+    def _fold():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        delta = delta_ref[0]                      # [block_q, 1]
+        p = _recompute_p(
+            q, k, lse_ref[0], q_start, k_start, sm_scale, causal
+        )
+        dv_acc[...] += jax.lax.dot_general(
+            p, do.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                         # p^T @ dO
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                         # dO @ V^T
+        ds = p * (dp - delta) * sm_scale
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                         # ds^T @ Q
+
+    @pl.when(qi == n_q - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
+    *, sm_scale, causal
+):
+    """dQ for one q block: grid (bh, q-block, kv-block), kv sequential."""
+    ki = pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    block_q = q_ref.shape[1]
+    block_k = k_ref.shape[1]
+    q_start = pl.program_id(1) * block_q
+    k_start = ki * block_k
+    needed = (not causal) or (q_start + block_q > k_start)
+
+    @pl.when(needed)
+    def _fold():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        delta = delta_ref[0]                      # [block_q, 1]
+        p = _recompute_p(
+            q, k, lse_ref[0], q_start, k_start, sm_scale, causal
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * sm_scale
+        dq_acc[...] += jax.lax.dot_general(
+            ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                         # ds @ K
+
+    @pl.when(ki == n_k - 1)
+    def _finish():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _flash_dims(q, k, block_q, block_k):
+    b, h, t, d = q.shape
+    t_k = k.shape[2]
+    block_q = min(block_q, t)
+    block_k = min(block_k, t_k)
+    if t % block_q or t_k % block_k:
+        raise ValueError(
+            f"T={t}/T_k={t_k} not divisible by blocks ({block_q},{block_k})"
+        )
+    return b, h, t, t_k, d, block_q, block_k
+
+
+_SEM = lambda *names: pltpu.CompilerParams(  # noqa: E731
+    dimension_semantics=tuple(
+        getattr(pltpu.GridDimensionSemantics, n) for n in names
+    )
+)
+
+
+def _flash_fwd_call(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    b, h, t, t_k, d, block_q, block_k = _flash_dims(q, k, block_q, block_k)
+    qs = q.reshape(b * h, t, d)
+    ks = k.reshape(b * h, t_k, d)
+    vs = v.reshape(b * h, t_k, d)
+    vma = jax.typeof(qs).vma
+    out, lse = pl.pallas_call(
+        functools.partial(_flash_kernel, sm_scale=sm_scale, causal=causal),
+        out_shape=(
+            jax.ShapeDtypeStruct((b * h, t, d), q.dtype, vma=vma),
+            jax.ShapeDtypeStruct((b * h, t, 1), jnp.float32, vma=vma),
+        ),
+        grid=(b * h, t // block_q, t_k // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j, kk: (i, j, 0)),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        # kv dim carries the scratch accumulator -> sequential
+        compiler_params=_SEM("PARALLEL", "PARALLEL", "ARBITRARY"),
+        interpret=interpret,
+    )(qs, ks, vs)
+    return out.reshape(b, h, t, d), lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    out, _ = _flash_fwd_call(
+        q, k, v, causal, sm_scale, block_q, block_k, interpret
+    )
+    return out
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    out, lse = _flash_fwd_call(
+        q, k, v, causal, sm_scale, block_q, block_k, interpret
+    )
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse = res
+    b, h, t, t_k, d, block_q, block_k = _flash_dims(q, k, block_q, block_k)
+    qs = q.reshape(b * h, t, d)
+    ks = k.reshape(b * h, t_k, d)
+    vs = v.reshape(b * h, t_k, d)
+    dos = g.reshape(b * h, t, d)
+    # delta_i = rowsum(dO * O): the softmax-jacobian correction term
+    delta = jnp.sum(
+        dos.astype(jnp.float32) * out.reshape(b * h, t, d).astype(jnp.float32),
+        axis=-1, keepdims=True,
+    )                                             # [bh, t, 1], like lse
+    vma = jax.typeof(qs).vma
+    q_spec = pl.BlockSpec((1, block_q, d), lambda i, kj, qi: (i, qi, 0))
+    k_spec = pl.BlockSpec((1, block_k, d), lambda i, kj, qi: (i, kj, 0))
+    r_spec = pl.BlockSpec((1, block_q, 1), lambda i, kj, qi: (i, qi, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((b * h, t_k, d), k.dtype, vma=vma),
+            jax.ShapeDtypeStruct((b * h, t_k, d), v.dtype, vma=vma),
+        ),
+        grid=(b * h, t_k // block_k, t // block_q),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, r_spec, r_spec],
+        out_specs=(
+            pl.BlockSpec((1, block_k, d), lambda i, kj, qi: (i, kj, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, kj, qi: (i, kj, 0)),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=_SEM("PARALLEL", "PARALLEL", "ARBITRARY"),
+        interpret=interpret,
+    )(qs, ks, vs, dos, lse, delta)
+
+    q_spec2 = pl.BlockSpec((1, block_q, d), lambda i, qi, kj: (i, qi, 0))
+    k_spec2 = pl.BlockSpec((1, block_k, d), lambda i, qi, kj: (i, kj, 0))
+    r_spec2 = pl.BlockSpec((1, block_q, 1), lambda i, qi, kj: (i, qi, 0))
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, sm_scale=sm_scale, causal=causal
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype, vma=vma),
+        grid=(b * h, t // block_q, t_k // block_k),
+        in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, r_spec2, r_spec2],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, qi, kj: (i, qi, 0)),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=_SEM("PARALLEL", "PARALLEL", "ARBITRARY"),
+        interpret=interpret,
+    )(qs, ks, vs, dos, lse, delta)
+    return (
+        dq.reshape(q.shape),
+        dk.reshape(k.shape),
+        dv.reshape(v.shape),
+    )
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("causal", "sm_scale", "block_q", "block_k", "interpret"),
@@ -219,68 +471,26 @@ def flash_attention_tpu(
     q, k, v, *, causal=True, sm_scale=None, block_q=256, block_k=256,
     interpret=False,
 ):
-    """Fused flash attention.  q,k,v: [B, H, T, D]; T (and T_k) must be
+    """Fused flash attention, fully differentiable (custom_vjp with
+    Pallas dQ and dK/dV kernels — the standard two-kernel backward with
+    the logsumexp residual).  q,k,v: [B, H, T, D]; T (and T_k) must be
     divisible by the block sizes — ``flash_attention`` dispatches away
-    otherwise.  ``interpret=True`` runs the kernel in the Pallas
-    interpreter (any backend; how the tests exercise it)."""
-    b, h, t, d = q.shape
-    t_k = k.shape[2]
-    if sm_scale is None:
-        sm_scale = d**-0.5
-    block_q = min(block_q, t)
-    block_k = min(block_k, t_k)
-    if t % block_q or t_k % block_k:
-        raise ValueError(
-            f"T={t}/T_k={t_k} not divisible by blocks ({block_q},{block_k})"
+    otherwise.  ``interpret=True`` runs the kernels in the Pallas
+    interpreter (any backend; how the tests exercise them)."""
+    if not _HAVE_PALLAS:  # pragma: no cover
+        raise RuntimeError(
+            "Pallas is unavailable in this JAX install; use "
+            "flash_attention() which falls back to reference math"
         )
-
-    grid = (b * h, t // block_q, t_k // block_k)
-    qs = q.reshape(b * h, t, d)
-    ks = k.reshape(b * h, t_k, d)
-    vs = v.reshape(b * h, t_k, d)
-
-    kernel = functools.partial(
-        _flash_kernel, sm_scale=sm_scale, causal=causal
-    )
-    # propagate vma so the kernel composes with vma-checked shard_map
-    out = pl.pallas_call(
-        kernel,
-        out_shape=jax.ShapeDtypeStruct(
-            (b * h, t, d), q.dtype, vma=jax.typeof(qs).vma
-        ),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((block_q, d), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
-        ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=(
-                pltpu.GridDimensionSemantics.PARALLEL,
-                pltpu.GridDimensionSemantics.PARALLEL,
-                # kv dim carries the scratch accumulator -> sequential
-                pltpu.GridDimensionSemantics.ARBITRARY,
-            ),
-        ),
-        interpret=interpret,
-    )(qs, ks, vs)
-    return out.reshape(b, h, t, d)
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    return _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret)
 
 
 def flash_attention(q, k, v, *, causal=True, sm_scale=None):
-    """Dispatch: Pallas kernel on TPU (shapes permitting), reference
-    math elsewhere.
-
-    The forward-only kernel is used where no gradient flows (e.g.
-    inference/validation); training paths currently differentiate the
-    reference/blockwise form, whose VJP XLA generates.
-    """
+    """Dispatch: Pallas kernels on TPU (shapes permitting), reference
+    math elsewhere.  Differentiable on both paths — the TPU kernel
+    carries a custom_vjp with Pallas backward kernels."""
     t, t_k = q.shape[2], k.shape[2]
     divisible = t % min(256, t) == 0 and t_k % min(256, t_k) == 0
     if _HAVE_PALLAS and _on_tpu(q) and divisible:

@@ -45,7 +45,8 @@ def ulysses_attention(
     causal: bool = True,
     sm_scale: float | None = None,
     kv_rep: int = 1,
-    use_flash: bool = False,
+    use_flash: bool | None = None,
+    interpret: bool = False,
 ) -> jnp.ndarray:
     """Attention over a sequence sharded on ``axis_name``.
 
@@ -55,10 +56,10 @@ def ulysses_attention(
     compute).  Requires H (and H/kv_rep) divisible by the axis size.
     Returns the local output shard [B, H, T_loc, D].
 
-    ``use_flash=False`` (default) computes the local attention in the
-    differentiable dense form — REQUIRED under ``jax.grad``, because
-    the Pallas flash kernel is forward-only; pass ``use_flash=True``
-    only on inference/validation paths.
+    ``use_flash=None`` (default) auto-dispatches: the differentiable
+    Pallas flash kernels on TPU (custom_vjp), dense reference math
+    elsewhere.  True/False force a path; a forced flash off-TPU needs
+    ``interpret=True`` (Pallas interpreter) or it fails loudly.
     """
     sp = lax.axis_size(axis_name)
     h = q.shape[1]
@@ -74,8 +75,18 @@ def ulysses_attention(
     if kv_rep != 1:
         kh = jnp.repeat(kh, kv_rep, axis=1)
         vh = jnp.repeat(vh, kv_rep, axis=1)
-    attn = flash_attention if use_flash else mha_reference
-    oh = attn(qh, kh, vh, causal=causal, sm_scale=sm_scale)
+    if use_flash is None:
+        # auto: TPU kernel or reference
+        oh = flash_attention(qh, kh, vh, causal=causal, sm_scale=sm_scale)
+    elif use_flash:
+        from theanompi_tpu.ops.attention import flash_attention_tpu
+
+        oh = flash_attention_tpu(
+            qh, kh, vh, causal=causal, sm_scale=sm_scale,
+            interpret=interpret,
+        )
+    else:
+        oh = mha_reference(qh, kh, vh, causal=causal, sm_scale=sm_scale)
     return seq_to_heads(oh, axis_name)       # [B, H, T_loc, D]
 
 
