@@ -24,7 +24,75 @@ import numpy as np
 REPO = Path(__file__).resolve().parent
 
 
+def bench_llama() -> None:
+    """Secondary metric (TM_BENCH_MODEL=llama): decoder-LM training
+    tokens/sec/chip with the fused flash-attention kernels."""
+    from theanompi_tpu.models.llama import Llama
+    from theanompi_tpu.parallel import make_mesh, default_devices
+    from theanompi_tpu.utils import Recorder
+
+    devices = default_devices()
+    n_chips = len(devices)
+    cfg = dict(
+        dim=1024, n_layers=8, n_heads=16, n_kv_heads=8, ffn_dim=2816,
+        vocab=32000, seq_len=2048, batch_size=4, remat=True,
+        n_train=max(8 * 4 * n_chips, 64), n_val=8,
+    )
+    model = Llama(cfg)
+    model.build_model(n_replicas=n_chips)
+    model.compile_iter_fns(mesh=make_mesh(data=n_chips, devices=devices))
+
+    x, y = model.put_batch(model.data.train_batch(0))
+    lr = jnp.float32(1e-4)
+
+    def step():
+        out = model.train_step_fn(
+            model.params, model.opt_state, x, y, lr
+        )
+        model.params, model.opt_state = out[0], out[1]
+        return out[2]
+
+    float(step())  # compile
+    float(step())
+    n_steps = 10
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        loss = step()
+    float(loss)  # value-read fence (see base.py measurement note)
+    dt = time.perf_counter() - t0
+
+    tokens = n_steps * cfg["batch_size"] * n_chips * cfg["seq_len"]
+    per_chip = tokens / dt / n_chips
+
+    baseline_path = REPO / "BENCH_BASELINE.json"
+    vs_baseline = None
+    if baseline_path.exists():
+        base = json.loads(baseline_path.read_text())
+        if base.get("Llama_tokens_per_sec_per_chip"):
+            vs_baseline = round(
+                per_chip / float(base["Llama_tokens_per_sec_per_chip"]), 4
+            )
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    f"Llama-{cfg['n_layers']}L-{cfg['dim']}d tokens/sec/chip "
+                    f"(BSP, bf16, b{cfg['batch_size']}, T{cfg['seq_len']})"
+                ),
+                "value": round(per_chip, 2),
+                "unit": "tokens/sec/chip",
+                "vs_baseline": vs_baseline,
+            }
+        )
+    )
+
+
 def main() -> None:
+    import os
+
+    if os.environ.get("TM_BENCH_MODEL", "").lower() == "llama":
+        bench_llama()
+        return
     from theanompi_tpu.models import load_flagship
     from theanompi_tpu.parallel import make_mesh, default_devices
 

@@ -1,0 +1,141 @@
+"""Pipeline parallelism (parallel/pp.py) vs the unpipelined chain.
+
+New-framework scope — SURVEY §2.2 row "Pipeline parallel (PP)" (absent
+upstream).  A pipelined stack of stages must produce the SAME forward
+outputs and the SAME gradients as running the stages sequentially on
+one device — pipelining is a schedule, not a math change.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from theanompi_tpu.parallel.pp import (
+    last_stage_value,
+    merge_microbatches,
+    pipeline_apply,
+    split_microbatches,
+)
+
+S = 4          # stages
+M = 8          # microbatches
+B, D = 16, 8   # global batch, feature width
+
+
+def pipe_mesh(devices8):
+    return Mesh(np.array(devices8[:S]), ("pipe",))
+
+
+def stage_fn(p, x):
+    # one stage = one tanh-MLP layer with residual
+    return x + jnp.tanh(x @ p["w"] + p["b"])
+
+
+def make_params(rng, stacked: bool):
+    """Per-stage params; stacked=True gives the [S, ...] pipe layout."""
+    ws = rng.standard_normal((S, D, D)).astype(np.float32) * 0.5
+    bs = rng.standard_normal((S, D)).astype(np.float32) * 0.1
+    if stacked:
+        return {"w": jnp.asarray(ws), "b": jnp.asarray(bs)}
+    return [{"w": jnp.asarray(ws[i]), "b": jnp.asarray(bs[i])}
+            for i in range(S)]
+
+
+def sequential_ref(params_list, x):
+    for p in params_list:
+        x = stage_fn(p, x)
+    return x
+
+
+class TestForward:
+    def test_matches_sequential(self, devices8, rng):
+        mesh = pipe_mesh(devices8)
+        stacked = make_params(rng, stacked=True)
+        plist = make_params(rng, stacked=False)
+        x = rng.standard_normal((B, D)).astype(np.float32)
+        xm = split_microbatches(jnp.asarray(x), M)
+
+        def run(sp, xm):
+            # leading stage axis is consumed by the pipe sharding:
+            # inside the body each stage sees its own [D, D] slice
+            sp = jax.tree.map(lambda a: a[0], sp)
+            ys = pipeline_apply(stage_fn, sp, xm)
+            return ys
+
+        ys = jax.jit(
+            jax.shard_map(
+                run,
+                mesh=mesh,
+                in_specs=({"w": P("pipe"), "b": P("pipe")}, P()),
+                out_specs=P("pipe"),  # per-stage copies; last is valid
+            )
+        )(stacked, xm)
+        # out_specs P('pipe') stacks each stage's ys along axis 0 of a
+        # [S*M, mb, D] array; the LAST stage's block is the real output
+        got = merge_microbatches(np.asarray(ys)[-M:])
+        want = sequential_ref(plist, x)
+        np.testing.assert_allclose(got, np.asarray(want), rtol=2e-5,
+                                   atol=2e-5)
+
+
+class TestGradients:
+    def test_loss_and_grads_match_sequential(self, devices8, rng):
+        mesh = pipe_mesh(devices8)
+        stacked = make_params(rng, stacked=True)
+        plist = make_params(rng, stacked=False)
+        x = rng.standard_normal((B, D)).astype(np.float32)
+        tgt = rng.standard_normal((B, D)).astype(np.float32)
+        xm = split_microbatches(jnp.asarray(x), M)
+        tm = split_microbatches(jnp.asarray(tgt), M)
+
+        def pipe_loss(sp_stacked, xm, tm):
+            sp = jax.tree.map(lambda a: a[0], sp_stacked)
+            ys = pipeline_apply(stage_fn, sp, xm)
+            local = jnp.mean((ys - tm) ** 2)
+            return last_stage_value(local, "pipe")
+
+        def run(sp_stacked, xm, tm):
+            loss, grads = jax.value_and_grad(pipe_loss)(sp_stacked, xm, tm)
+            return loss, grads
+
+        loss, grads = jax.jit(
+            jax.shard_map(
+                run,
+                mesh=mesh,
+                in_specs=({"w": P("pipe"), "b": P("pipe")}, P(), P()),
+                out_specs=(P(), {"w": P("pipe"), "b": P("pipe")}),
+            )
+        )(stacked, xm, tm)
+
+        def seq_loss(plist):
+            y = sequential_ref(plist, jnp.asarray(x))
+            return jnp.mean((y - jnp.asarray(tgt)) ** 2)
+
+        want_loss = seq_loss(plist)
+        want_grads = jax.grad(seq_loss)(plist)
+        np.testing.assert_allclose(float(loss), float(want_loss), rtol=1e-5)
+        for i in range(S):
+            np.testing.assert_allclose(
+                np.asarray(grads["w"])[i], np.asarray(want_grads[i]["w"]),
+                rtol=2e-4, atol=2e-4, err_msg=f"stage {i} dw",
+            )
+            np.testing.assert_allclose(
+                np.asarray(grads["b"])[i], np.asarray(want_grads[i]["b"]),
+                rtol=2e-4, atol=2e-4, err_msg=f"stage {i} db",
+            )
+
+
+class TestHelpers:
+    def test_split_merge_roundtrip(self, rng):
+        x = jnp.asarray(rng.standard_normal((12, 3)).astype(np.float32))
+        m = split_microbatches(x, 4)
+        assert m.shape == (4, 3, 3)
+        np.testing.assert_array_equal(np.asarray(merge_microbatches(m)),
+                                      np.asarray(x))
+
+    def test_split_rejects_indivisible(self, rng):
+        x = jnp.zeros((10, 3))
+        with pytest.raises(ValueError, match="not divisible"):
+            split_microbatches(x, 4)

@@ -42,6 +42,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from theanompi_tpu.models.base import TMModel
 from theanompi_tpu.models.data.lm_synthetic import MarkovLMData
+from theanompi_tpu.ops.attention import flash_attention
 from theanompi_tpu.ops import optimizers as opt_lib
 from theanompi_tpu.parallel import DATA_AXIS, MODEL_AXIS, SEQ_AXIS, make_mesh
 from theanompi_tpu.parallel.ring_attention import ring_attention
@@ -213,8 +214,20 @@ class Llama(TMModel):
         q = rope(q, pos)
         k = rope(k, pos)
         # GQA: KV stays compact on the wire; repeated only at compute
-        attn = ring_attention if self.sp_mode == "ring" else ulysses_attention
-        o = attn(q, k, v, SEQ_AXIS, causal=True, kv_rep=h_loc // hkv_loc)
+        rep = h_loc // hkv_loc
+        if self.sp == 1:
+            # no sequence sharding: skip the ring/all_to_all machinery
+            # and hit the fused kernel (reference math off-TPU) directly
+            if rep != 1:
+                k = jnp.repeat(k, rep, axis=1)
+                v = jnp.repeat(v, rep, axis=1)
+            o = flash_attention(q, k, v, causal=True)
+        else:
+            attn = (
+                ring_attention if self.sp_mode == "ring"
+                else ulysses_attention
+            )
+            o = attn(q, k, v, SEQ_AXIS, causal=True, kv_rep=rep)
         x = x + tp_lib.row_parallel(_unheads(o), p["wo"]).astype(cdtype)
 
         xn = rms_norm(x, p["mlp_norm"])

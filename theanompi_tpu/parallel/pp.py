@@ -1,0 +1,109 @@
+"""Pipeline parallelism: GPipe-style microbatching over a ``pipe``
+mesh axis (new-framework scope — SURVEY §2.2 row "Pipeline parallel
+(PP)", absent upstream).
+
+TPU-native shape: every stage is a mesh coordinate running the SAME
+stage function (SPMD) on its OWN stage parameters (a pytree whose
+leaves are sharded over the pipe axis outside).  ``pipeline_apply``
+runs the classic schedule as one ``lax.scan``: at each tick every
+stage processes one microbatch-slot and hands its activation to the
+next stage over a chain ``ppermute`` (nearest-neighbour ICI traffic).
+``M`` microbatches through ``S`` stages take ``M + S - 1`` ticks — the
+standard GPipe bubble of (S-1)/(M+S-1); raise M to amortize.
+
+Autodiff needs no pipeline-aware code: the backward of the scan is the
+reverse schedule and the transpose of the chain ppermute is the
+reversed chain, so ``jax.grad`` of a pipelined loss IS backward
+pipelining, with XLA overlapping the hops.
+
+The output microbatches are only *valid* on the LAST stage (other
+coordinates hold garbage slots); ``last_stage_value`` broadcasts a
+last-stage scalar (e.g. the loss) to every stage so the train step can
+return replicated metrics.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+PIPE_AXIS = "pipe"
+
+
+def stage_index(axis_name: str = PIPE_AXIS):
+    return lax.axis_index(axis_name)
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stage_params,
+    x_microbatches: jnp.ndarray,
+    axis_name: str = PIPE_AXIS,
+):
+    """Run ``M`` microbatches through the stage chain.
+
+    - ``stage_fn(stage_params, x) -> y`` — one stage's compute; input
+      and output must share shape/dtype (the inter-stage activation).
+    - ``x_microbatches`` — [M, ...] real data on stage 0 (other stages'
+      copies are ignored).
+    - returns [M, ...] outputs, VALID ON THE LAST STAGE ONLY.
+
+    Must be called inside ``shard_map`` with ``axis_name`` in the mesh.
+    """
+    s = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    m = x_microbatches.shape[0]
+    ticks = m + s - 1
+    # chain (not ring): stage i feeds i+1; stage 0 receives zeros
+    perm = [(i, i + 1) for i in range(s - 1)]
+
+    # the carry becomes stage-varying after one tick; mark it varying
+    # up front so the scan types close (vma-checked shard_map)
+    ys0 = lax.pvary(jnp.zeros_like(x_microbatches), (axis_name,))
+    recv0 = lax.pvary(jnp.zeros_like(x_microbatches[0]), (axis_name,))
+
+    def tick(carry, t):
+        recv, ys = carry
+        # stage 0 injects microbatch t (clipped during drain ticks)
+        feed = x_microbatches[jnp.clip(t, 0, m - 1)]
+        inp = jnp.where(idx == 0, feed, recv)
+        out = stage_fn(stage_params, inp)
+        sent = lax.ppermute(out, axis_name, perm)
+        # last stage completes microbatch t-(s-1) at tick t
+        w = jnp.clip(t - (s - 1), 0, m - 1)
+        valid = jnp.logical_and(t >= s - 1, idx == s - 1)
+        slot = lax.dynamic_index_in_dim(ys, w, 0, keepdims=False)
+        ys = lax.dynamic_update_index_in_dim(
+            ys, jnp.where(valid, out, slot), w, 0
+        )
+        return (sent, ys), None
+
+    (_, ys), _ = lax.scan(tick, (recv0, ys0), jnp.arange(ticks))
+    return ys
+
+
+def last_stage_value(value, axis_name: str = PIPE_AXIS):
+    """Broadcast ``value`` from the last stage to every stage (others
+    contribute zeros through a psum)."""
+    s = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    return lax.psum(jnp.where(idx == s - 1, value, jnp.zeros_like(value)),
+                    axis_name)
+
+
+def split_microbatches(x: jnp.ndarray, n_microbatches: int) -> jnp.ndarray:
+    """[B, ...] -> [M, B/M, ...] (B must divide)."""
+    b = x.shape[0]
+    if b % n_microbatches:
+        raise ValueError(
+            f"batch {b} not divisible into {n_microbatches} microbatches"
+        )
+    return x.reshape((n_microbatches, b // n_microbatches) + x.shape[1:])
+
+
+def merge_microbatches(y: jnp.ndarray) -> jnp.ndarray:
+    """[M, mb, ...] -> [M*mb, ...]."""
+    return y.reshape((-1,) + y.shape[2:])
