@@ -26,6 +26,12 @@ os.environ["TM_TPU_PLATFORM"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_default_device", jax.devices("cpu")[0])
+# persistent compile cache: repeat suite runs skip most XLA compiles
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.environ.get("TM_TEST_CACHE", "/tmp/tm_tpu_jax_cache"),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 import pytest  # noqa: E402
 

@@ -46,6 +46,7 @@ class TestBufferSpread:
         assert replica_buffer_spread(tree) == 0.0
 
 
+@pytest.mark.slow
 class TestWorkerIntegration:
     def test_bsp_epoch_check_passes(self, devices8, monkeypatch):
         from theanompi_tpu.workers import bsp_worker

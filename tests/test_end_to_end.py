@@ -31,6 +31,7 @@ def _run(n_epochs=1, devices=8, config_extra=None, **kw):
 
 
 class TestBSPEndToEnd:
+    @pytest.mark.slow
     def test_convergence_smoke(self):
         """WRN-10-1 on synthetic CIFAR must learn in 3 epochs under BSP
         on the 8-device mesh (convergence smoke, SURVEY §4d)."""

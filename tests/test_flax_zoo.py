@@ -5,6 +5,7 @@ as in-tree models."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 TINY = {"batch_size": 4, "width": 16, "lr": 0.05, "n_train": 512,
         "n_val": 64, "lr_schedule": None}
@@ -41,6 +42,7 @@ class TestFlaxLayerAdapter:
         )
 
 
+@pytest.mark.slow
 class TestFlaxUnderRules:
     def test_bsp_convergence_smoke(self):
         from theanompi_tpu.workers import bsp_worker

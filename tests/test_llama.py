@@ -45,6 +45,7 @@ class TestLayoutInvariance:
         assert np.isclose(e5_1, e5_8, rtol=1e-4), (e5_1, e5_8)
 
     @pytest.mark.parametrize("sp_mode", ["ring", "ulysses"])
+    @pytest.mark.slow
     def test_sgd_training_matches_across_meshes(self, devices8, sp_mode):
         """SGD training curves must coincide on 1x1x1 and 2x2x2 — this
         catches any layout-dependent gradient scaling (unlike Adam,
@@ -70,6 +71,7 @@ class TestLayoutInvariance:
         )
 
 
+@pytest.mark.slow
 class TestTraining:
     def test_loss_decreases_3d_parallel(self, devices8):
         m = build(devices8, data=2, tp=2, sp=2, batch_size=2)
@@ -88,6 +90,7 @@ class TestTraining:
         assert np.isfinite(loss)
 
 
+@pytest.mark.slow
 class TestCheckpoint:
     def test_save_load_roundtrip(self, devices8, tmp_path):
         m = build(devices8, data=2, tp=2, sp=1, batch_size=2)

@@ -124,6 +124,7 @@ CFG = {
 }
 
 
+@pytest.mark.slow
 class TestLSTMModel:
     def test_bsp_convergence_smoke(self):
         from theanompi_tpu.workers import bsp_worker

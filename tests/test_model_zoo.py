@@ -6,6 +6,8 @@ CPU runtime sane; architecture is unchanged."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from theanompi_tpu.parallel import make_mesh
 from theanompi_tpu.utils import Recorder
 

@@ -33,14 +33,16 @@ def stage_fn(p, x):
     return x + jnp.tanh(x @ p["w"] + p["b"])
 
 
-def make_params(rng, stacked: bool):
-    """Per-stage params; stacked=True gives the [S, ...] pipe layout."""
+def make_params(rng):
+    """Per-stage params in the [S, ...] pipe layout."""
     ws = rng.standard_normal((S, D, D)).astype(np.float32) * 0.5
     bs = rng.standard_normal((S, D)).astype(np.float32) * 0.1
-    if stacked:
-        return {"w": jnp.asarray(ws), "b": jnp.asarray(bs)}
-    return [{"w": jnp.asarray(ws[i]), "b": jnp.asarray(bs[i])}
-            for i in range(S)]
+    return {"w": jnp.asarray(ws), "b": jnp.asarray(bs)}
+
+
+def unstack_params(stacked):
+    """The SAME weights as a per-stage list for the sequential reference."""
+    return [{"w": stacked["w"][i], "b": stacked["b"][i]} for i in range(S)]
 
 
 def sequential_ref(params_list, x):
@@ -52,8 +54,8 @@ def sequential_ref(params_list, x):
 class TestForward:
     def test_matches_sequential(self, devices8, rng):
         mesh = pipe_mesh(devices8)
-        stacked = make_params(rng, stacked=True)
-        plist = make_params(rng, stacked=False)
+        stacked = make_params(rng)
+        plist = unstack_params(stacked)
         x = rng.standard_normal((B, D)).astype(np.float32)
         xm = split_microbatches(jnp.asarray(x), M)
 
@@ -83,8 +85,8 @@ class TestForward:
 class TestGradients:
     def test_loss_and_grads_match_sequential(self, devices8, rng):
         mesh = pipe_mesh(devices8)
-        stacked = make_params(rng, stacked=True)
-        plist = make_params(rng, stacked=False)
+        stacked = make_params(rng)
+        plist = unstack_params(stacked)
         x = rng.standard_normal((B, D)).astype(np.float32)
         tgt = rng.standard_normal((B, D)).astype(np.float32)
         xm = split_microbatches(jnp.asarray(x), M)

@@ -1,0 +1,165 @@
+"""Sharded checkpointing (utils/sharded_checkpoint.py) — SURVEY §5.4
+"Orbax-style sharded checkpoint": save/restore per-shard with a JSON
+index, never materializing a full partitioned leaf on one host (the
+npz path host-gathers, which cannot scale to the Llama-3-8B stretch
+config whose params are initialized sharded — models/llama.py).
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from theanompi_tpu.parallel import make_mesh
+from theanompi_tpu.utils import (
+    is_sharded_checkpoint,
+    latest_checkpoint,
+    load_sharded_checkpoint,
+    save_sharded_checkpoint,
+)
+
+
+@pytest.fixture()
+def mesh222(devices8):
+    return make_mesh(data=2, model=2, seq=2, devices=devices8)
+
+
+def make_trees(mesh):
+    sh = NamedSharding(mesh, P(None, "model"))
+    rep = NamedSharding(mesh, P())
+    w = jax.device_put(
+        jnp.arange(16 * 8, dtype=jnp.float32).reshape(16, 8), sh
+    )
+    g = jax.device_put(jnp.full((6,), 2.0, jnp.bfloat16), rep)
+    return {"params": {"w": w, "g": g}}
+
+
+class TestRoundtrip:
+    def test_save_load_same_layout(self, mesh222, tmp_path):
+        trees = make_trees(mesh222)
+        save_sharded_checkpoint(tmp_path, 5, trees, {"epoch": 5, "lr": 0.1})
+        path = latest_checkpoint(tmp_path)
+        assert path is not None and is_sharded_checkpoint(path)
+
+        out, meta = load_sharded_checkpoint(path, trees)
+        np.testing.assert_array_equal(
+            np.asarray(out["params"]["w"]), np.asarray(trees["params"]["w"])
+        )
+        assert out["params"]["g"].dtype == jnp.bfloat16
+        np.testing.assert_array_equal(
+            np.asarray(out["params"]["g"]).astype(np.float32),
+            np.full((6,), 2.0, np.float32),
+        )
+        assert out["params"]["w"].sharding == trees["params"]["w"].sharding
+        assert meta["epoch"] == 5 and meta["lr"] == 0.1
+
+    def test_cross_layout_restore(self, mesh222, devices8, tmp_path):
+        """A checkpoint saved on one mesh layout restores onto another
+        (shards are reassembled region-by-region)."""
+        trees = make_trees(mesh222)
+        save_sharded_checkpoint(tmp_path, 0, trees)
+        mesh2 = make_mesh(data=2, model=4, seq=1, devices=devices8)
+        like = {
+            "params": {
+                "w": jax.device_put(
+                    jnp.zeros((16, 8), jnp.float32),
+                    NamedSharding(mesh2, P("model", None)),
+                ),
+                "g": jax.device_put(
+                    jnp.zeros((6,), jnp.bfloat16), NamedSharding(mesh2, P())
+                ),
+            }
+        }
+        out, _ = load_sharded_checkpoint(latest_checkpoint(tmp_path), like)
+        np.testing.assert_array_equal(
+            np.asarray(out["params"]["w"]), np.asarray(trees["params"]["w"])
+        )
+        assert out["params"]["w"].sharding == like["params"]["w"].sharding
+
+
+class TestNoHostGather:
+    def test_saved_files_are_shard_sized(self, mesh222, tmp_path):
+        """No written file holds more than one shard of a partitioned
+        leaf, and replicated leaves are written exactly once."""
+        trees = make_trees(mesh222)
+        path = save_sharded_checkpoint(tmp_path, 0, trees)
+        index = json.loads((path / "index.p0.json").read_text())
+
+        w_entry = index["params:['w']"]
+        # model axis = 2 → each shard holds half the columns
+        assert len(w_entry["shards"]) >= 2
+        for s in w_entry["shards"]:
+            arr = np.load(path / s["file"])
+            assert arr.size <= (16 * 8) // 2
+        g_entry = index["params:['g']"]
+        assert len(g_entry["shards"]) == 1  # replicated: one copy
+
+    def test_restore_materializes_only_shard_buffers(
+        self, mesh222, tmp_path, monkeypatch
+    ):
+        """The restore path allocates at most shard-sized host buffers
+        for partitioned leaves (np.empty is the only materializing
+        allocation in the region assembler)."""
+        trees = make_trees(mesh222)
+        save_sharded_checkpoint(tmp_path, 0, trees)
+
+        full_nbytes = 16 * 8 * 4
+        seen = []
+        real_empty = np.empty
+
+        def spy_empty(shape, dtype=float, **kw):
+            arr = real_empty(shape, dtype, **kw)
+            seen.append(arr.nbytes)
+            return arr
+
+        monkeypatch.setattr(np, "empty", spy_empty)
+        out, _ = load_sharded_checkpoint(latest_checkpoint(tmp_path), trees)
+        assert max(seen) < full_nbytes
+        np.testing.assert_array_equal(
+            np.asarray(out["params"]["w"]), np.asarray(trees["params"]["w"])
+        )
+
+
+class TestLlamaIntegration:
+    @pytest.mark.slow
+    def test_llama_tp2_sp2_roundtrip(self, devices8, tmp_path):
+        """Llama tp=2,sp=2: model.save auto-picks the sharded format,
+        resume restores the training state (VERDICT r1 item 5)."""
+        from theanompi_tpu.models.llama import Llama
+        from theanompi_tpu.utils import Recorder
+
+        cfg = dict(
+            dim=32, n_layers=2, n_heads=4, n_kv_heads=2, ffn_dim=64,
+            vocab=32, seq_len=16, batch_size=2, tp=2, sp=2,
+            n_train=8, n_val=4, compute_dtype="float32", n_epochs=1,
+        )
+        mesh = make_mesh(data=2, model=2, seq=2, devices=devices8)
+        model = Llama(cfg)
+        model.build_model(n_replicas=2)
+        model.compile_iter_fns(mesh=mesh)
+        rec = Recorder(verbose=False)
+        model.train_iter(0, rec)
+        model.epoch = 3
+        model.save(str(tmp_path), rec)
+
+        path = latest_checkpoint(tmp_path)
+        assert is_sharded_checkpoint(path), (
+            "partitioned params must auto-select the sharded format"
+        )
+
+        model2 = Llama(cfg)
+        model2.build_model(n_replicas=2)
+        model2.compile_iter_fns(mesh=mesh)
+        rec2 = Recorder(verbose=False)
+        assert model2.load(str(tmp_path), rec2)
+        assert model2.epoch == 3
+        for a, b in zip(
+            jax.tree.leaves(model.params), jax.tree.leaves(model2.params)
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # training continues from the restored state
+        model2.train_iter(1, rec2)
+        assert np.isfinite(rec2.train_losses[-1])

@@ -132,3 +132,23 @@ class Cifar10Data:
             return self._syn.val_batch(i)
         sl = slice(i * self.global_batch, (i + 1) * self.global_batch)
         return self._val_x[sl], self._val_y[sl]
+
+    def batch_indices(self, i: int):
+        """Device-resident dataset support (``device_data_cache``);
+        note the real-data path then skips host-side augmentation —
+        the cached dataset is the standardized images."""
+        if self.synthetic:
+            return self._syn.batch_indices(i)
+        return self._perm[i * self.global_batch : (i + 1) * self.global_batch]
+
+    def epoch_permutation(self):
+        if self.synthetic:
+            return self._syn.epoch_permutation()
+        return self._perm
+
+    def dataset_arrays(self, split: str = "train"):
+        if self.synthetic:
+            return self._syn.dataset_arrays(split)
+        if split == "train":
+            return self._train_x, self._train_y
+        return self._val_x, self._val_y

@@ -256,6 +256,23 @@ class ImageNetData:
             return self._prefetch.get()
         return self._load_train(i)  # random access fallback
 
+    def batch_indices(self, i: int):
+        """Device-resident dataset support (synthetic mode only; real
+        pre-batched files stream per batch)."""
+        if self.synthetic:
+            return self._syn.batch_indices(i)
+        return None
+
+    def epoch_permutation(self):
+        if self.synthetic:
+            return self._syn.epoch_permutation()
+        return None
+
+    def dataset_arrays(self, split: str = "train"):
+        if self.synthetic:
+            return self._syn.dataset_arrays(split)
+        return None  # real files: too big for HBM residency
+
     def val_batch(self, i: int):
         if self.synthetic:
             return self._syn.val_batch(i)

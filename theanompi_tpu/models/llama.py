@@ -44,7 +44,13 @@ from theanompi_tpu.models.base import TMModel
 from theanompi_tpu.models.data.lm_synthetic import MarkovLMData
 from theanompi_tpu.ops.attention import flash_attention
 from theanompi_tpu.ops import optimizers as opt_lib
-from theanompi_tpu.parallel import DATA_AXIS, MODEL_AXIS, SEQ_AXIS, make_mesh
+from theanompi_tpu.parallel import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    SEQ_AXIS,
+    get_strategy,
+    make_mesh,
+)
 from theanompi_tpu.parallel.ring_attention import ring_attention
 from theanompi_tpu.parallel.ulysses import ulysses_attention
 from theanompi_tpu.parallel import tp as tp_lib
@@ -286,7 +292,23 @@ class Llama(TMModel):
         self.params = None
         self.opt_state = None
 
-    def compile_iter_fns(self, mesh: Mesh | None = None, **_) -> None:
+    def compile_iter_fns(
+        self,
+        mesh: Mesh | None = None,
+        exch_strategy: str | None = None,
+        **unknown,
+    ) -> None:
+        if unknown:
+            raise TypeError(
+                f"Llama.compile_iter_fns: unknown kwargs {sorted(unknown)}"
+            )
+        # the DP gradient exchange honors the strategy knob (wire dtype
+        # x collective shape — ici16 is the reference's nccl16
+        # analogue); it applies to the data axis only, TP/SP
+        # collectives are part of the model math
+        strat = get_strategy(
+            exch_strategy or self.config.get("exch_strategy", "ici32")
+        )
         if mesh is None:
             mesh = make_mesh(model=self.tp, seq=self.sp)
         self.mesh = mesh
@@ -309,16 +331,38 @@ class Llama(TMModel):
         optimizer = self.optimizer
 
         def step(params, opt_state, x, y, lr):
+            # Pre-cast params to data-VARYING before autodiff: if they
+            # stayed invariant, the vma transpose of their broadcast
+            # into the data-varying compute would insert an implicit
+            # fp32 psum of the grads — summing (not averaging) over
+            # data and bypassing the strategy's wire dtype.  With the
+            # cast, grads come back as per-shard local grads and the
+            # strategy's allreduce-mean below IS the DP exchange.
+            params_v = jax.tree.map(
+                lambda a: lax.pcast(a, DATA_AXIS, to="varying"), params
+            )
+
             def loss_fn(p):
                 logits = self._forward(p, x)
-                loss, err = self._metrics(logits, y)
+                # LOCAL (per-data-shard) metrics: data axis stays out
+                # of autodiff (see cast above); SP/TP reductions remain
+                # part of the model math
+                loss = tp_lib.sharded_softmax_xent(logits, y, self.vocab)
+                err = tp_lib.sharded_top1_err(logits, y, self.vocab)
+                loss = lax.pmean(loss, SEQ_AXIS)
+                err = lax.pmean(err, SEQ_AXIS)
                 return loss, err
 
-            # check_vma=True autodiff returns exact grads for every
-            # layout — no grad_sync / manual reduction (module docstring)
+            # check_vma=True autodiff returns exact grads for the TP/SP
+            # layout (psum↔pvary transposes); the data-parallel mean is
+            # THE exchange, routed through the strategy (bf16 wire on
+            # ici16/nccl16 — reference: exchanger_strategy fp16 wire)
             (loss, err), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
-            )(params)
+            )(params_v)
+            grads = strat(grads, DATA_AXIS)
+            loss = lax.pmean(loss, DATA_AXIS)
+            err = lax.pmean(err, DATA_AXIS)
             params, opt_state = optimizer.update(params, grads, opt_state, lr)
             return params, opt_state, loss, err
 
@@ -384,9 +428,10 @@ class Llama(TMModel):
         self.params, self.opt_state, loss, err = self._train_step(
             self.params, self.opt_state, x, y, jnp.float32(self.current_lr)
         )
-        loss_v, err_v = float(loss), float(err)   # value-read fence
         recorder.end("calc")
-        recorder.train_error(count, loss_v, err_v)
+        # device scalars, materialized lazily at the next print window
+        # or epoch end (Recorder.flush) — no per-step host fence
+        recorder.train_error(count, loss, err)
 
     def val_iter(self, count: int, recorder: Recorder):
         x, y = self.put_batch(self.data.val_batch(count))

@@ -20,6 +20,7 @@ from theanompi_tpu.parallel.exchange import (
     allreduce_mean,
     elastic_pair_update,
     elastic_center_merge,
+    elastic_center_merge_masked,
     gossip_push,
     gossip_merge,
     gossip_matrix_round,
@@ -42,6 +43,7 @@ __all__ = [
     "allreduce_mean",
     "elastic_pair_update",
     "elastic_center_merge",
+    "elastic_center_merge_masked",
     "gossip_push",
     "gossip_merge",
     "gossip_matrix_round",

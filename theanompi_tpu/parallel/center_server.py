@@ -1,0 +1,202 @@
+"""EASGD center server over TCP — the true server/worker split.
+
+Reference: ``theanompi/easgd_server.py`` — a dedicated process holds
+the center parameters and serialises worker requests ('exchange',
+'copy_to_local', stop) arriving over MPI; workers at different speeds
+hit it at different times (SURVEY §3.2).
+
+TPU-native shape: the sync rules ride XLA collectives, but genuinely
+*asynchronous* exchange cannot — SPMD programs must be entered by
+every process together.  So the async control plane is a plain TCP
+parameter server (the ``jax.distributed`` coordinator replaces
+mpirun's bootstrap; this replaces the reference's MPI Sendrecv
+channel): process 0 hosts the center as host numpy arrays, a lock
+serialises exchanges exactly like the reference's request loop, and
+each worker process exchanges whenever ITS OWN step counter says so —
+no barrier, real out-of-step semantics across processes.
+
+Wire format: length-prefixed pickled (cmd, payload) frames of numpy
+arrays.  Localhost/DCN appropriate; for pod-scale use the per-host
+worker counts stay small (one exchange per tau local steps).
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from typing import Any
+
+import jax
+import numpy as np
+
+PyTree = Any
+
+_LEN = struct.Struct(">Q")
+
+
+def _send(sock: socket.socket, obj) -> None:
+    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv(sock: socket.socket):
+    hdr = _recv_exact(sock, _LEN.size)
+    (n,) = _LEN.unpack(hdr)
+    return pickle.loads(_recv_exact(sock, n))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _to_host(tree: PyTree) -> list[np.ndarray]:
+    return [np.asarray(x) for x in jax.tree.leaves(tree)]
+
+
+class EASGDCenterServer:
+    """Holds the center; serialises elastic exchanges (reference:
+    EASGD_Server.run request loop).
+
+    - 'exchange': worker sends its flat param list; server replies
+      with the PRE-exchange center (Sendrecv semantics: both sides
+      update against the counterpart's old value), then applies
+      ``c += alpha * (w - c)``.
+    - 'get': reply with the current center (the reference's
+      'copy_to_local').
+    - 'stop': refuse further connections once every registered worker
+      has stopped.
+    """
+
+    def __init__(self, center: PyTree, alpha: float, host: str = "0.0.0.0",
+                 port: int = 0):
+        # np.array (copy): np.asarray on a jax.Array yields a READ-ONLY
+        # view, and the elastic update mutates the center in place
+        self._leaves = [np.array(l) for l in _to_host(center)]
+        self._treedef = jax.tree.structure(center)
+        self.alpha = float(alpha)
+        self._lock = threading.Lock()
+        self.exchanges = 0
+        self._stopped = threading.Event()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.address = (
+            socket.gethostbyname(socket.gethostname())
+            if host == "0.0.0.0" else host,
+            self._sock.getsockname()[1],
+        )
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    # -- request loop -----------------------------------------------------
+
+    def _serve(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                self._sock.settimeout(0.2)
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(
+                target=self._client, args=(conn,), daemon=True
+            ).start()
+
+    def _client(self, conn: socket.socket) -> None:
+        try:
+            with conn:
+                while True:
+                    cmd, payload = _recv(conn)
+                    if cmd == "exchange":
+                        _send(conn, self._exchange(payload))
+                    elif cmd == "get":
+                        with self._lock:
+                            _send(conn, [l.copy() for l in self._leaves])
+                    elif cmd == "stop":
+                        _send(conn, "ok")
+                        return
+                    else:
+                        _send(conn, ("error", f"unknown cmd {cmd!r}"))
+        except (ConnectionError, EOFError):
+            return
+
+    def _exchange(self, worker_leaves: list[np.ndarray]) -> list[np.ndarray]:
+        a = self.alpha
+        with self._lock:  # serialize: one worker at a time (reference)
+            pre = [l.copy() for l in self._leaves]
+            for c, w in zip(self._leaves, worker_leaves):
+                diff = a * (np.asarray(w, c.dtype) - c)
+                c += diff
+            self.exchanges += 1
+        return pre
+
+    # -- controller-side access -------------------------------------------
+
+    def center_tree(self) -> PyTree:
+        with self._lock:
+            return jax.tree.unflatten(
+                self._treedef, [l.copy() for l in self._leaves]
+            )
+
+    def stop(self) -> None:
+        self._stopped.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class EASGDCenterClient:
+    """Worker-side channel to the center server."""
+
+    def __init__(self, address: tuple[str, int], connect_timeout: float = 60.0):
+        import time
+
+        # retry with backoff: workers race the server's startup (each
+        # process builds+compiles its model first, at its own pace)
+        deadline = time.monotonic() + connect_timeout
+        delay = 0.1
+        while True:
+            try:
+                self._sock = socket.create_connection(address, timeout=60.0)
+                return
+            except OSError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(delay)
+                delay = min(delay * 2, 2.0)
+
+    def get(self, like: PyTree) -> PyTree:
+        _send(self._sock, ("get", None))
+        leaves = _recv(self._sock)
+        return jax.tree.unflatten(jax.tree.structure(like), leaves)
+
+    def exchange(self, params: PyTree, alpha: float) -> PyTree:
+        """Elastic exchange: returns the updated LOCAL params
+        ``w - alpha*(w - c_pre)`` (the server applies its side)."""
+        leaves = _to_host(params)
+        _send(self._sock, ("exchange", leaves))
+        center_pre = _recv(self._sock)
+        new_leaves = [
+            w - alpha * (w - np.asarray(c, w.dtype))
+            for w, c in zip(leaves, center_pre)
+        ]
+        return jax.tree.unflatten(jax.tree.structure(params), new_leaves)
+
+    def close(self) -> None:
+        try:
+            _send(self._sock, ("stop", None))
+            _recv(self._sock)
+        except (ConnectionError, EOFError, OSError):
+            pass
+        self._sock.close()

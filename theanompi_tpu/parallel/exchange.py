@@ -128,6 +128,33 @@ def elastic_center_merge(
     return _tree_pair_map(pair, locals_stacked, center)
 
 
+def elastic_center_merge_masked(
+    locals_stacked: PyTree,
+    center: PyTree,
+    alpha: float,
+    mask: jnp.ndarray,
+) -> tuple[PyTree, PyTree]:
+    """EASGD round where only ``mask``-ed workers exchange.
+
+    ``mask`` — ``[W]`` {0,1} runtime array (no recompile per draw);
+    1 = this worker's elastic pair update happens this round, 0 = the
+    worker keeps training against a stale center.  This is the
+    out-of-step shape of the reference (each worker exchanges when ITS
+    OWN local step counter hits tau — workers at different speeds hit
+    it at different times; the server serializes whoever shows up,
+    which the summed masked pushes reproduce for same-round arrivals).
+    """
+
+    def pair(w, c):
+        m = mask.astype(jnp.float32).reshape(
+            (-1,) + (1,) * (w.ndim - 1)
+        ).astype(w.dtype)
+        diff = alpha * (w - c) * m
+        return w - diff, c + jnp.sum(diff, axis=0)
+
+    return _tree_pair_map(pair, locals_stacked, center)
+
+
 # ---------------------------------------------------------------------------
 # GoSGD: gossip SGD (Blot et al. 2016).  Reference: GOSGD_Worker —
 # with prob p, isend (params, score/2) to a random peer and halve own
@@ -221,6 +248,51 @@ def gossip_matrix_round(
         return ((own + recv) / tot).astype(p.dtype)
 
     return jax.tree.map(merge, stacked_params), new_scores
+
+
+def gossip_send(
+    scores: jnp.ndarray,
+    route: jnp.ndarray,
+    push_mask: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Send side of a (possibly delayed) gossip round: pushing workers
+    halve their score NOW (reference: sender halves at isend time);
+    returns ``(new_scores, routing)`` where ``routing[s, d]`` carries
+    the in-flight score mass from s to d."""
+    w = scores.shape[0]
+    sent = push_mask.astype(scores.dtype) * scores * 0.5
+    routing = jax.nn.one_hot(route, w, dtype=scores.dtype) * sent[:, None]
+    return scores - sent, routing
+
+
+def gossip_deliver(
+    stacked_params: PyTree,
+    scores: jnp.ndarray,
+    stale_params: PyTree,
+    routing: jnp.ndarray,
+) -> tuple[PyTree, jnp.ndarray]:
+    """Receive side: merge in-flight payloads into the CURRENT replicas.
+
+    ``stale_params`` is the sender-side snapshot taken when ``routing``
+    was built (``gossip_send``) — with a staleness delay the payload a
+    worker merges is D rounds old, exactly like the reference's
+    messages sitting in MPI buffers while both peers kept training.
+    """
+    w = scores.shape[0]
+    recv_score = jnp.sum(routing, axis=0)
+    new_scores = scores + recv_score
+
+    def merge(cur, stale):
+        if not jnp.issubdtype(cur.dtype, jnp.floating):
+            return cur
+        f32 = cur.astype(jnp.float32)
+        st = stale.astype(jnp.float32)
+        recv = jnp.tensordot(routing, st, axes=[[0], [0]])
+        own = scores.reshape((w,) + (1,) * (f32.ndim - 1)) * f32
+        tot = new_scores.reshape((w,) + (1,) * (f32.ndim - 1))
+        return ((own + recv) / tot).astype(cur.dtype)
+
+    return jax.tree.map(merge, stacked_params, stale_params), new_scores
 
 
 def gossip_merge(

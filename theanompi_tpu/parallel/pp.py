@@ -62,8 +62,10 @@ def pipeline_apply(
 
     # the carry becomes stage-varying after one tick; mark it varying
     # up front so the scan types close (vma-checked shard_map)
-    ys0 = lax.pvary(jnp.zeros_like(x_microbatches), (axis_name,))
-    recv0 = lax.pvary(jnp.zeros_like(x_microbatches[0]), (axis_name,))
+    ys0 = lax.pcast(jnp.zeros_like(x_microbatches), (axis_name,),
+                    to="varying")
+    recv0 = lax.pcast(jnp.zeros_like(x_microbatches[0]), (axis_name,),
+                      to="varying")
 
     def tick(carry, t):
         recv, ys = carry

@@ -3,7 +3,24 @@
 TPU-native rebuild of ``theanompi/lib/{recorder,helper_funcs}.py``.
 """
 
+from theanompi_tpu.utils.checkpoint import (
+    latest_checkpoint,
+    load_checkpoint,
+    save_checkpoint,
+)
 from theanompi_tpu.utils.recorder import Recorder
-from theanompi_tpu.utils.checkpoint import save_checkpoint, load_checkpoint, latest_checkpoint
+from theanompi_tpu.utils.sharded_checkpoint import (
+    is_sharded_checkpoint,
+    load_sharded_checkpoint,
+    save_sharded_checkpoint,
+)
 
-__all__ = ["Recorder", "save_checkpoint", "load_checkpoint", "latest_checkpoint"]
+__all__ = [
+    "Recorder",
+    "save_checkpoint",
+    "load_checkpoint",
+    "latest_checkpoint",
+    "save_sharded_checkpoint",
+    "load_sharded_checkpoint",
+    "is_sharded_checkpoint",
+]

@@ -101,12 +101,26 @@ def load_checkpoint(
 
 
 def latest_checkpoint(directory: str | Path) -> Path | None:
+    """Newest checkpoint in ``directory`` — either format (npz file or
+    ``.shards`` dir from ``sharded_checkpoint``)."""
     directory = Path(directory)
     if not directory.is_dir():
         return None
-    best, best_step = None, -1
-    for p in directory.glob("ckpt_*.npz"):
-        m = re.fullmatch(r"ckpt_(\d+)\.npz", p.name)
-        if m and int(m.group(1)) > best_step:
-            best, best_step = p, int(m.group(1))
+    best, best_key = None, (-1, -1.0)
+    for p in directory.iterdir():
+        m = re.fullmatch(r"ckpt_(\d+)(\.npz|\.shards)", p.name)
+        if not m:
+            continue
+        if m.group(2) == ".shards":
+            from theanompi_tpu.utils.sharded_checkpoint import (
+                is_sharded_checkpoint,
+            )
+
+            if not is_sharded_checkpoint(p):
+                continue  # uncommitted partial save
+        # same step in both formats (e.g. replicated rerun of a
+        # sharded run): prefer the newer write, not iteration order
+        key = (int(m.group(1)), p.stat().st_mtime)
+        if key > best_key:
+            best, best_key = p, key
     return best

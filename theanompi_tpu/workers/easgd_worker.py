@@ -30,8 +30,13 @@ from typing import Any, Sequence
 import jax
 import jax.numpy as jnp
 
+import numpy as np
+
 from theanompi_tpu import launcher as _launcher
-from theanompi_tpu.parallel import elastic_center_merge
+from theanompi_tpu.parallel import (
+    elastic_center_merge,
+    elastic_center_merge_masked,
+)
 from theanompi_tpu.utils import Recorder
 from theanompi_tpu.workers.bsp_worker import _build_mesh, _resolve_model
 from theanompi_tpu.workers.replica_engine import ReplicaEngine
@@ -51,6 +56,8 @@ def run(
     resume: bool = False,
     print_freq: int = 40,
     verbose: bool = True,
+    speeds: Sequence[float] | None = None,
+    center_addr: str | None = None,
     **extra: Any,
 ) -> dict:
     """Train ``modelclass`` under EASGD; returns a summary dict.
@@ -58,8 +65,37 @@ def run(
     ``alpha`` — elastic coupling strength (reference default: the
     moving-rate config knob, commonly ``alpha = 1/N``); ``tau`` —
     local steps between exchanges (reference default 1–16).
+
+    ``speeds`` — per-worker relative speeds in (0, 1] (out-of-step
+    mode): worker w advances one local step per tick with rate
+    ``speeds[w]`` and exchanges with the center when ITS OWN counter
+    hits ``tau`` — workers genuinely run different step counts between
+    exchanges, the reference's defining asynchrony (SURVEY §3.2).
+
+    When launched across processes (``jax.distributed`` via
+    tmlauncher), each PROCESS is one EASGD worker over its local chips
+    and exchanges with a TCP center server on process 0
+    (``parallel/center_server.py``) at its own cadence — no barrier.
+    ``center_addr`` ("host:port") pins the server address; default
+    publishes it through the jax.distributed KV store.
     """
     del server_device  # no dedicated chip needed: center is replicated
+    import jax as _jax
+
+    if _jax.process_count() > 1:
+        return _run_distributed(
+            modelfile=modelfile,
+            modelclass=modelclass,
+            config={**(config or {}), **extra},
+            alpha=alpha,
+            tau=tau,
+            n_epochs=n_epochs,
+            checkpoint_dir=checkpoint_dir,
+            resume=resume,
+            print_freq=print_freq,
+            verbose=verbose,
+            center_addr=center_addr,
+        )
     mesh = _build_mesh(devices)
     n_workers = mesh.shape["data"]
 
@@ -107,6 +143,23 @@ def run(
     def exchange(stacked, c):
         return elastic_center_merge(stacked, c, alpha)
 
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def exchange_masked(stacked, c, mask):
+        return elastic_center_merge_masked(stacked, c, alpha, mask)
+
+    if speeds is not None:
+        speeds_arr = np.asarray(speeds, np.float64)
+        if speeds_arr.shape != (n_workers,):
+            raise ValueError(
+                f"speeds must have one entry per worker "
+                f"({n_workers}); got shape {speeds_arr.shape}"
+            )
+        if np.any(speeds_arr <= 0) or np.any(speeds_arr > 1):
+            raise ValueError("speeds must lie in (0, 1]")
+        credit = np.zeros(n_workers)
+        since_exchange = np.zeros(n_workers, np.int64)
+        local_steps = np.zeros(n_workers, np.int64)
+
     data = model.data
     if verbose:
         print(
@@ -117,6 +170,7 @@ def run(
         )
 
     step = 0
+    n_exchanges = 0
     while model.epoch < model.n_epochs:
         epoch = model.epoch
         recorder.start_epoch()
@@ -127,21 +181,55 @@ def run(
             batch = data.train_batch(i)
             recorder.end("wait")
 
-            recorder.start()
-            loss, err = engine.train_step(batch, model.current_lr)
-            loss_v, err_v = float(loss), float(err)  # value-read fence
-            recorder.end("calc")
-            recorder.train_error(i, loss_v, err_v)
-
-            step += 1
-            if step % tau == 0:
+            if speeds is None:
                 recorder.start()
-                engine.params, center = exchange(engine.params, center)
-                # value-read fence (see ClassifierModel.train_iter note)
-                _ = float(
-                    jax.tree.leaves(center)[0].reshape(-1)[0]
+                loss, err = engine.train_step(batch, model.current_lr)
+                recorder.end("calc")
+                # device scalars, materialized lazily (Recorder.flush)
+                recorder.train_error(i, loss, err)
+
+                step += 1
+                if step % tau == 0:
+                    n_exchanges += n_workers
+                    recorder.start()
+                    engine.params, center = exchange(engine.params, center)
+                    # value-read fence (ClassifierModel.train_iter note)
+                    _ = float(
+                        jax.tree.leaves(center)[0].reshape(-1)[0]
+                    )
+                    recorder.end("comm")
+            else:
+                # out-of-step mode: each tick, worker w steps iff its
+                # speed credit crosses 1; it exchanges when ITS OWN
+                # step counter hits tau — different workers exchange
+                # at different local step counts
+                credit += speeds_arr
+                mask = credit >= 1.0
+                credit -= mask
+                if not mask.any():
+                    continue
+                recorder.start()
+                loss, err = engine.train_step(
+                    batch, model.current_lr,
+                    step_mask=mask.astype(np.float32),
                 )
-                recorder.end("comm")
+                recorder.end("calc")
+                recorder.train_error(i, loss, err)
+                local_steps += mask
+                since_exchange += mask
+                exch = since_exchange >= tau
+                if exch.any():
+                    recorder.start()
+                    engine.params, center = exchange_masked(
+                        engine.params, center,
+                        jnp.asarray(exch, jnp.float32),
+                    )
+                    _ = float(
+                        jax.tree.leaves(center)[0].reshape(-1)[0]
+                    )
+                    recorder.end("comm")
+                    since_exchange[exch] = 0
+                    n_exchanges += int(exch.sum())
             recorder.print_train_info(i)
 
         if data.n_batch_val:
@@ -167,10 +255,160 @@ def run(
     model.opt_state = engine.mean_opt_state()
 
     last_val = recorder.val_records[-1] if recorder.val_records else {}
+    out = {
+        "epochs": model.epoch,
+        "iterations": recorder.n_iter,
+        "exchanges": n_exchanges,
+        "final_train_loss": (
+            recorder.train_losses[-1] if recorder.train_losses else None
+        ),
+        "final_val": last_val,
+        "epoch_times": recorder.epoch_times,
+        "recorder": recorder,
+        "model": model,
+    }
+    if speeds is not None:
+        out["local_steps"] = local_steps.tolist()
+    return out
+
+
+def _run_distributed(
+    *,
+    modelfile: str,
+    modelclass: str,
+    config: dict,
+    alpha: float | None,
+    tau: int | None,
+    n_epochs: int | None,
+    checkpoint_dir: str | None,
+    resume: bool,
+    print_freq: int,
+    verbose: bool,
+    center_addr: str | None,
+) -> dict:
+    """Multi-process EASGD: each PROCESS is one worker over its local
+    chips; process 0 additionally hosts the TCP center server.  No
+    barrier anywhere in the training loop — each process trains and
+    exchanges at its own pace (the reference's server/worker split,
+    with DCN TCP replacing MPI Sendrecv)."""
+    from theanompi_tpu.parallel import make_mesh
+    from theanompi_tpu.parallel.center_server import (
+        EASGDCenterClient,
+        EASGDCenterServer,
+    )
+
+    pid = jax.process_index()
+    local = jax.local_devices()
+    mesh = make_mesh(data=len(local), devices=local)
+
+    Model = _resolve_model(modelfile, modelclass)
+    cfg = dict(config)
+    if n_epochs is not None:
+        cfg["n_epochs"] = n_epochs
+    model = Model(cfg)
+    model.build_model(n_replicas=len(local))
+    model.compile_iter_fns(mesh=mesh)
+
+    n_procs = jax.process_count()
+    alpha = float(alpha if alpha is not None
+                  else cfg.get("alpha", 1.0 / n_procs))
+    tau = int(tau if tau is not None else cfg.get("tau", 4))
+
+    recorder = Recorder(
+        rank=pid, size=n_procs, print_freq=print_freq, verbose=verbose
+    )
+    if resume and checkpoint_dir:
+        # EVERY process loads (checkpoint_dir must be on a shared
+        # filesystem, the standard pod setup) so all workers agree on
+        # the restored epoch and start from the center weights
+        if model.load(checkpoint_dir, recorder):
+            model.epoch += 1
+
+    server = None
+    if pid == 0:
+        # bind all interfaces so remote hosts can reach the center;
+        # the published address is this host's routable name
+        host, port = ("0.0.0.0", 0)
+        if center_addr:
+            host, port = center_addr.rsplit(":", 1)
+            port = int(port)
+        server = EASGDCenterServer(
+            model.params, alpha, host=host, port=port
+        )
+        addr = f"{server.address[0]}:{server.address[1]}"
+    if center_addr:
+        addr = center_addr
+    elif n_procs > 1:
+        # share the (possibly ephemeral) port over the jax.distributed
+        # KV store — same transport the coordinator bootstrap uses
+        from jax._src import distributed as _dist
+
+        client = _dist.global_state.client
+        if pid == 0:
+            client.key_value_set("tm_easgd_center", addr)
+        else:
+            addr = client.blocking_key_value_get("tm_easgd_center", 60000)
+    tcp = EASGDCenterClient(
+        (addr.rsplit(":", 1)[0], int(addr.rsplit(":", 1)[1]))
+    )
+
+    data = model.data
+    if verbose and pid == 0:
+        print(
+            f"EASGD(distributed): {n_procs} worker processes x "
+            f"{len(local)} chips, alpha={alpha:.4f} tau={tau}",
+            flush=True,
+        )
+
+    step = 0
+    n_exchanges = 0
+    while model.epoch < model.n_epochs:
+        epoch = model.epoch
+        recorder.start_epoch()
+        if hasattr(data, "shuffle"):
+            data.shuffle(epoch + pid * 7919)  # decorrelate worker data
+        for i in range(data.n_batch_train):
+            model.train_iter(i, recorder)
+            step += 1
+            if step % tau == 0:
+                recorder.flush()  # fence local step before reading params
+                recorder.start()
+                host_params = jax.device_get(model.params)
+                new_params = tcp.exchange(host_params, alpha)
+                model.params = jax.device_put(
+                    new_params, jax.tree.map(lambda x: x.sharding,
+                                             model.params),
+                )
+                recorder.end("comm")
+                n_exchanges += 1
+            recorder.print_train_info(i)
+
+        if data.n_batch_val:
+            vals = [model.val_iter(j, recorder)
+                    for j in range(data.n_batch_val)]
+            l, e, e5 = (float(sum(v) / len(v)) for v in zip(*vals))
+            recorder.val_error(l, e, e5)
+        recorder.end_epoch(epoch)
+        model.adjust_hyperp(epoch + 1)
+        model.epoch += 1
+
+    tcp.close()
+    if server is not None:
+        # center owns the final weights + checkpoint (server semantics)
+        center = server.center_tree()
+        model.params = jax.device_put(
+            center, jax.tree.map(lambda x: x.sharding, model.params)
+        )
+        if checkpoint_dir:
+            model.save(checkpoint_dir, recorder)
+        server.stop()
+
+    last_val = recorder.val_records[-1] if recorder.val_records else {}
     return {
         "epochs": model.epoch,
         "iterations": recorder.n_iter,
-        "exchanges": step // tau,
+        "exchanges": n_exchanges,
+        "process_index": pid,
         "final_train_loss": (
             recorder.train_losses[-1] if recorder.train_losses else None
         ),

@@ -62,6 +62,7 @@ def run(
     *,
     config: dict | None = None,
     push_prob: float | None = None,
+    staleness: int | None = None,
     n_epochs: int | None = None,
     checkpoint_dir: str | None = None,
     resume: bool = False,
@@ -73,7 +74,14 @@ def run(
     """Train ``modelclass`` under GoSGD; returns a summary dict.
 
     ``push_prob`` — per-worker per-iteration Bernoulli push probability
-    (the reference's ``p``; its IMDB LSTM demo used small p)."""
+    (the reference's ``p``; its IMDB LSTM demo used small p).
+
+    ``staleness`` — rounds a pushed message spends "in flight" before
+    the receiver merges it (0 = same-round delivery).  The reference's
+    isend/probe pair delivered whenever the receiver polled — pushes
+    arrived stale while both peers kept training; this knob reproduces
+    that staleness deterministically (sender still halves its score at
+    send time)."""
     mesh = _build_mesh(devices)
     n_workers = mesh.shape["data"]
     if n_workers < 2:
@@ -93,6 +101,11 @@ def run(
     p_push = float(
         push_prob if push_prob is not None else cfg.get("push_prob", 0.25)
     )
+    delay = int(
+        staleness if staleness is not None else cfg.get("staleness", 0)
+    )
+    if delay < 0:
+        raise ValueError(f"staleness must be >= 0, got {delay}")
 
     recorder = Recorder(
         rank=0, size=n_workers, print_freq=print_freq, verbose=verbose
@@ -113,6 +126,17 @@ def run(
     )
 
     gossip = jax.jit(gossip_matrix_round, donate_argnums=(0,))
+    if delay:
+        from collections import deque
+
+        from theanompi_tpu.parallel.exchange import (
+            gossip_deliver,
+            gossip_send,
+        )
+
+        send = jax.jit(gossip_send)
+        deliver = jax.jit(gossip_deliver, donate_argnums=(0,))
+        in_flight: "deque" = deque()  # (routing, params+opt snapshot)
     host_rng = np.random.default_rng(
         seed if seed is not None else model.seed + 101
     )
@@ -139,9 +163,9 @@ def run(
 
             recorder.start()
             loss, err = engine.train_step(batch, model.current_lr)
-            loss_v, err_v = float(loss), float(err)  # value-read fence
             recorder.end("calc")
-            recorder.train_error(i, loss_v, err_v)
+            # device scalars, materialized lazily (Recorder.flush)
+            recorder.train_error(i, loss, err)
 
             # host-sampled gossip round (reference: Bernoulli(p) isend
             # to a uniform random peer != self)
@@ -155,17 +179,44 @@ def run(
                 # consensus oscillate (momentum then points away from
                 # the merged point), so the whole (params, opt) pair is
                 # averaged with the same scores.
-                merged, scores = gossip(
-                    {"params": engine.params, "opt": engine.opt_state},
-                    scores,
-                    jnp.asarray(route, jnp.int32),
-                    jnp.asarray(push, jnp.float32),
-                )
-                engine.params = merged["params"]
-                engine.opt_state = merged["opt"]
+                if not delay:
+                    merged, scores = gossip(
+                        {"params": engine.params, "opt": engine.opt_state},
+                        scores,
+                        jnp.asarray(route, jnp.int32),
+                        jnp.asarray(push, jnp.float32),
+                    )
+                    engine.params = merged["params"]
+                    engine.opt_state = merged["opt"]
+                else:
+                    # stale delivery: score halves now, payload rides
+                    # in flight for `delay` rounds
+                    scores, routing = send(
+                        scores,
+                        jnp.asarray(route, jnp.int32),
+                        jnp.asarray(push, jnp.float32),
+                    )
+                    # deep-copy the snapshot: the next train step
+                    # DONATES engine.params/opt_state, which would
+                    # invalidate a bare reference held in the queue
+                    in_flight.append((routing, jax.tree.map(
+                        jnp.copy,
+                        {"params": engine.params, "opt": engine.opt_state},
+                    )))
                 _ = float(scores[0])  # value-read fence
                 recorder.end("comm")
                 n_rounds += 1
+            if delay and len(in_flight) > delay:
+                recorder.start()
+                routing_d, snap_d = in_flight.popleft()
+                merged, scores = deliver(
+                    {"params": engine.params, "opt": engine.opt_state},
+                    scores, snap_d, routing_d,
+                )
+                engine.params = merged["params"]
+                engine.opt_state = merged["opt"]
+                _ = float(scores[0])
+                recorder.end("comm")
             recorder.print_train_info(i)
 
         if data.n_batch_val:

@@ -121,6 +121,32 @@ class ReplicaEngine:
             donate_argnums=(0, 1, 2),
         )
 
+        def masked_step(params, net_state, opt_state, x, y, lr, rng, m):
+            """One local step, applied only where ``m`` (per-worker
+            {0,1}) is set — the heterogeneous-speed machinery for the
+            async rules: unmasked workers' state is untouched, so
+            replicas genuinely advance by different step counts."""
+            new_p, new_s, new_o, loss, err = local_step(
+                params, net_state, opt_state, x, y, lr, rng
+            )
+            on = m > 0
+
+            def keep(new, old):
+                return jnp.where(on, new, old)
+
+            return (
+                jax.tree.map(keep, new_p, params),
+                jax.tree.map(keep, new_s, net_state),
+                jax.tree.map(keep, new_o, opt_state),
+                loss,
+                err,
+            )
+
+        self._train_step_masked = jax.jit(
+            jax.vmap(masked_step, in_axes=(0, 0, 0, 0, 0, None, 0, 0)),
+            donate_argnums=(0, 1, 2),
+        )
+
         def local_val(params, net_state, x, y):
             out, _ = net.apply(
                 params, net_state, model.prep_input(x), train=False
@@ -156,19 +182,41 @@ class ReplicaEngine:
 
     # -- stepping --------------------------------------------------------
 
-    def train_step(self, batch, lr: float):
+    def train_step(self, batch, lr: float, step_mask=None):
         """One local SGD step on every replica; returns mean (loss, err)
-        as device arrays (read them to fence)."""
+        as device arrays (read them to fence).
+
+        ``step_mask`` — optional ``[W]`` {0,1} array: only masked
+        workers advance (heterogeneous speeds for the async rules);
+        the mean is over the active workers."""
         x, y = self.put_batch(batch)
         self._rng, k = jax.random.split(self._rng)
         keys = jax.random.split(k, self.n_workers)
+        if step_mask is None:
+            (
+                self.params,
+                self.net_state,
+                self.opt_state,
+                losses,
+                errs,
+            ) = self._train_step(
+                self.params,
+                self.net_state,
+                self.opt_state,
+                x,
+                y,
+                jnp.float32(lr),
+                keys,
+            )
+            return jnp.mean(losses), jnp.mean(errs)
+        m = jnp.asarray(step_mask, jnp.float32)
         (
             self.params,
             self.net_state,
             self.opt_state,
             losses,
             errs,
-        ) = self._train_step(
+        ) = self._train_step_masked(
             self.params,
             self.net_state,
             self.opt_state,
@@ -176,8 +224,10 @@ class ReplicaEngine:
             y,
             jnp.float32(lr),
             keys,
+            m,
         )
-        return jnp.mean(losses), jnp.mean(errs)
+        n_on = jnp.maximum(jnp.sum(m), 1.0)
+        return jnp.sum(losses * m) / n_on, jnp.sum(errs * m) / n_on
 
     def val_step(self, batch, params=None, net_state=None):
         """Validate; by default each replica scores its own batch shard
