@@ -26,7 +26,6 @@ import time
 from pathlib import Path
 
 import jax
-import jax.numpy as jnp
 
 REPO = Path(__file__).resolve().parent
 
@@ -52,15 +51,16 @@ def _peak_flops(devices) -> float | None:
     return None
 
 
-def _step_flops(compiled, n_devices: int) -> float | None:
-    """TOTAL FLOPs of one train step across all devices.
+def _step_flops(model, n_devices: int) -> float | None:
+    """TOTAL FLOPs of one train step across all devices, from the
+    model's ACTIVE step (``train_step_cost_analysis``).
 
     XLA's ``cost_analysis()`` dict reports the PER-DEVICE partitioned
     module (verified on this image: a 4-way-sharded 4.19M-FLOP matmul
     reports 1.05M), so the dict branch scales by ``n_devices``; the
     old list API is one dict per partition and sums to the total."""
     try:
-        ca = compiled.cost_analysis()
+        ca = model.train_step_cost_analysis()
         if isinstance(ca, list):
             flops = sum(float(d.get("flops", 0.0)) for d in ca)
         else:
@@ -68,6 +68,8 @@ def _step_flops(compiled, n_devices: int) -> float | None:
         return flops if flops > 0 else None
     except Exception:
         return None
+
+
 
 
 def _emit(metric, value, unit, vs_baseline, extra=None):
@@ -95,8 +97,9 @@ def bench_llama() -> None:
     tokens/sec/chip with the fused flash-attention kernels."""
     from theanompi_tpu.models.llama import Llama
     from theanompi_tpu.parallel import default_devices, make_mesh
-    from theanompi_tpu.utils import Recorder
+    from theanompi_tpu.utils import Recorder, enable_compile_cache
 
+    enable_compile_cache()
     devices = default_devices()
     n_chips = len(devices)
     cfg = dict(
@@ -126,13 +129,7 @@ def bench_llama() -> None:
 
     extra = {}
     peak = _peak_flops(devices)
-    x, y = model.put_batch(model.data.train_batch(0))
-    flops = _step_flops(
-        model.train_step_fn.lower(
-            model.params, model.opt_state, x, y, jnp.float32(1e-4)
-        ).compile(),
-        n_chips,
-    )
+    flops = _step_flops(model, n_chips)
     if flops and peak:
         extra["mfu"] = round(flops * n_steps / dt / (n_chips * peak), 4)
     _emit(
@@ -153,8 +150,9 @@ def main() -> None:
         return
     from theanompi_tpu.models import load_flagship
     from theanompi_tpu.parallel import default_devices, make_mesh
-    from theanompi_tpu.utils import Recorder
+    from theanompi_tpu.utils import Recorder, enable_compile_cache
 
+    enable_compile_cache()
     devices = default_devices()
     n_chips = len(devices)
     mesh = make_mesh(data=n_chips, devices=devices)
@@ -189,15 +187,7 @@ def main() -> None:
 
     extra = {}
     peak = _peak_flops(devices)
-    x, y = model.put_batch(model.data.train_batch(0))
-    key = jax.random.PRNGKey(0)
-    flops = _step_flops(
-        model.train_step_fn.lower(
-            model.params, model.net_state, model.opt_state, x, y,
-            jnp.float32(0.01), key,
-        ).compile(),
-        n_chips,
-    )
+    flops = _step_flops(model, n_chips)
     if flops is None:
         # analytic fallback: ResNet-50 v1.5 fwd ~4.1 GFLOP/img @224,
         # training ~3x fwd

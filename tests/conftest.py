@@ -26,12 +26,11 @@ os.environ["TM_TPU_PLATFORM"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_default_device", jax.devices("cpu")[0])
-# persistent compile cache: repeat suite runs skip most XLA compiles
-jax.config.update(
-    "jax_compilation_cache_dir",
-    os.environ.get("TM_TEST_CACHE", "/tmp/tm_tpu_jax_cache"),
-)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+# persistent compile cache: repeat suite runs skip most XLA compiles;
+# shared location with bench/gate so all entry points warm each other
+from theanompi_tpu.utils import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()
 
 import pytest  # noqa: E402
 

@@ -145,6 +145,32 @@ class TestEASGDEndToEnd:
         assert result["exchanges"] > 0
 
 
+class TestEASGDStabilityGuardrail:
+    """VERDICT r1 item 10: a diverging alpha*N > 1 config must be a
+    hard error (not a warning that scrolls away) unless the caller
+    explicitly opts in with allow_unstable=True."""
+
+    def test_unstable_alpha_rejected(self):
+        with pytest.raises(ValueError, match="beta=4.00 > 1"):
+            _run_easgd(alpha=0.5)  # 8 workers -> beta = 4
+
+    def test_allow_unstable_downgrades_to_warning(self):
+        with pytest.warns(UserWarning, match="unstable"):
+            _run_easgd(
+                alpha=0.5,
+                n_epochs=1,
+                config_extra={"allow_unstable": True, "n_train": 32},
+                tau=2,
+            )
+
+    def test_stable_alpha_no_warning(self):
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", UserWarning)
+            _run_easgd(n_epochs=1, config_extra={"n_train": 32}, tau=2)
+
+
 @pytest.mark.slow
 class TestOutOfStepEASGD:
     """VERDICT r1 item 4: workers must run at DIFFERENT speeds and

@@ -20,12 +20,13 @@ SMALL = dict(
 )
 
 
-def build(devices, *, data=1, tp=1, sp=1, **over):
-    cfg = dict(SMALL, tp=tp, sp=sp, **over)
+def build(devices, *, data=1, tp=1, sp=1, pp=1, **over):
+    cfg = dict(SMALL, tp=tp, sp=sp, pp=pp, **over)
     m = Llama(cfg)
     m.build_model(n_replicas=data)
     mesh = make_mesh(
-        data=data, model=tp, seq=sp, devices=devices[: data * tp * sp]
+        data=data, model=tp, seq=sp, pipe=pp,
+        devices=devices[: data * tp * sp * pp],
     )
     m.compile_iter_fns(mesh=mesh)
     return m
@@ -43,6 +44,40 @@ class TestLayoutInvariance:
         assert np.isclose(l1, l8, rtol=1e-4), (l1, l8)
         assert np.isclose(e1, e8, rtol=1e-4), (e1, e8)
         assert np.isclose(e5_1, e5_8, rtol=1e-4), (e5_1, e5_8)
+
+    def test_val_loss_same_with_pipeline_parallel(self, devices8):
+        """pp is a layout choice: dp=2 x tp=2 x pp=2 must reproduce the
+        1x1x1x1 numbers exactly (GPipe microbatching reorders only the
+        summation, fp32 here)."""
+        rec = Recorder(rank=0)
+        m1 = build(devices8, data=1)
+        mp = build(devices8, data=2, tp=2, pp=2, batch_size=2)
+        l1, e1, e5_1 = m1.val_iter(0, rec)
+        lp, ep, e5_p = mp.val_iter(0, rec)
+        assert np.isclose(l1, lp, rtol=1e-4), (l1, lp)
+        assert np.isclose(e1, ep, rtol=1e-4), (e1, ep)
+        assert np.isclose(e5_1, e5_p, rtol=1e-4), (e5_1, e5_p)
+
+    @pytest.mark.slow
+    def test_sgd_training_matches_with_pipeline_parallel(self, devices8):
+        """VERDICT r1 item 2: Llama trains under dp x tp x pp and the
+        SGD loss curve coincides with the unpipelined 1x1x1x1 run
+        (catches any microbatch/injection/grad-masking bug — backward
+        through the pipeline must be exact, not approximate)."""
+        m1 = build(devices8, data=1, optimizer="sgd", lr=0.5)
+        mp = build(
+            devices8, data=2, tp=2, pp=2, batch_size=2,
+            optimizer="sgd", lr=0.5,
+        )
+        r1, rp = Recorder(rank=0), Recorder(rank=0)
+        for i in range(4):
+            m1.train_iter(i, r1)
+            mp.train_iter(i, rp)
+        r1.flush()
+        rp.flush()
+        np.testing.assert_allclose(
+            r1.train_losses, rp.train_losses, rtol=1e-3
+        )
 
     @pytest.mark.parametrize("sp_mode", ["ring", "ulysses"])
     @pytest.mark.slow
@@ -73,6 +108,17 @@ class TestLayoutInvariance:
 
 @pytest.mark.slow
 class TestTraining:
+    def test_full_4d_parallel_step(self, devices8):
+        """tp x sp x pp all active at once (dp=1 on 8 devices): the
+        axes compose — ring attention inside pipelined stages inside
+        the vma-checked shard_map."""
+        m = build(devices8, data=1, tp=2, sp=2, pp=2, batch_size=4)
+        rec = Recorder(rank=0)
+        for i in range(2):
+            m.train_iter(i, rec)
+        rec.flush()
+        assert np.isfinite(rec.train_losses).all()
+
     def test_loss_decreases_3d_parallel(self, devices8):
         m = build(devices8, data=2, tp=2, sp=2, batch_size=2)
         rec = Recorder(rank=0)

@@ -396,6 +396,30 @@ class ClassifierModel(TMModel):
         Public so benchmarks/drivers can run unfenced step chains."""
         return self._train_step
 
+    def train_step_cost_analysis(self):
+        """XLA ``cost_analysis()`` of the ACTIVE train step — the
+        cached-data variant when ``device_data_cache`` is live, else
+        the staged-batch step, so FLOP counts describe the path
+        ``train_iter`` actually runs.  Call after at least one
+        ``train_iter`` (the cached path stages lr/permutation lazily);
+        with a persistent compile cache the ``.compile()`` here
+        deserializes the warmup step's executable instead of
+        recompiling."""
+        if self._train_step_cached is not None and self._perm_dev is not None:
+            lowered = self._train_step_cached.lower(
+                self.params, self.net_state, self.opt_state,
+                self._step_dev, self._device_cache[0],
+                self._device_cache[1], self._perm_dev, self._lr_dev,
+                self._key0_dev,
+            )
+        else:
+            x, y = self.put_batch(self.data.train_batch(0))
+            lowered = self._train_step.lower(
+                self.params, self.net_state, self.opt_state, x, y,
+                jnp.float32(self.current_lr), self._rng,
+            )
+        return lowered.compile().cost_analysis()
+
     def train_iter(self, count: int, recorder: Recorder) -> None:
         if self._train_step_cached is not None:
             # device-resident path: batches are ordered by the DEVICE

@@ -1,4 +1,5 @@
-"""Llama-family decoder transformer with 3-D parallelism (DP x TP x SP).
+"""Llama-family decoder transformer with 4-D parallelism
+(DP x TP x SP x PP).
 
 New-framework scope: the reference is DP-only (SURVEY §2.2); the
 BASELINE Llama-3-8B stretch config requires tensor parallelism and
@@ -13,6 +14,11 @@ sequence parallelism, which shape this model's design:
   either ``parallel/ring_attention`` (ppermute KV ring, the default)
   or ``parallel/ulysses`` (head all-to-all), selected by the
   ``sp_mode`` config knob.
+- **PP** over ``pipe`` — GPipe microbatching via
+  ``parallel/pp.pipeline_apply``: decoder layers stacked on a
+  pipe-sharded leading dim (each stage holds ``n_layers/pp``
+  consecutive layers), embed replicated, head masked to the last
+  stage.  Knobs: ``pp``, ``pp_microbatches``.
 
 The WHOLE train step — embed, L layers, loss, backward, optimizer —
 is ONE vma-checked ``shard_map`` under ``jit``: XLA overlaps the TP
@@ -47,9 +53,14 @@ from theanompi_tpu.ops import optimizers as opt_lib
 from theanompi_tpu.parallel import (
     DATA_AXIS,
     MODEL_AXIS,
+    PIPE_AXIS,
     SEQ_AXIS,
     get_strategy,
+    last_stage_value,
     make_mesh,
+    merge_microbatches,
+    pipeline_apply,
+    split_microbatches,
 )
 from theanompi_tpu.parallel.ring_attention import ring_attention
 from theanompi_tpu.parallel.ulysses import ulysses_attention
@@ -113,6 +124,14 @@ class Llama(TMModel):
         self.head_dim = self.dim // self.n_heads
         self.tp = int(c.get("tp", 1))
         self.sp = int(c.get("sp", 1))
+        self.pp = int(c.get("pp", 1))
+        batch = int(c.get("batch_size", 8))
+        # default microbatch count: 2 per stage halves the GPipe bubble
+        # vs M=S, when the local batch allows it
+        default_m = 2 * self.pp if batch % (2 * self.pp) == 0 else self.pp
+        self.pp_microbatches = int(
+            c.get("pp_microbatches", default_m) if self.pp > 1 else 1
+        )
         self.sp_mode = str(c.get("sp_mode", "ring"))
         self.remat = bool(c.get("remat", True))
         self.compute_dtype = jnp.dtype(c.get("compute_dtype", "bfloat16"))
@@ -134,6 +153,12 @@ class Llama(TMModel):
         assert self.vocab % self.tp == 0, "vocab must divide by tp"
         assert self.ffn_dim % self.tp == 0, "ffn_dim must divide by tp"
         assert self.seq_len % self.sp == 0, "seq_len must divide by sp"
+        assert self.n_layers % self.pp == 0, "n_layers must divide by pp"
+        if self.pp > 1:
+            assert batch % self.pp_microbatches == 0, (
+                f"local batch {batch} must divide into "
+                f"{self.pp_microbatches} microbatches"
+            )
         assert self.sp_mode in ("ring", "ulysses"), self.sp_mode
         if self.sp_mode == "ulysses":
             h_loc = self.n_heads // self.tp
@@ -152,7 +177,13 @@ class Llama(TMModel):
     # -- parameter layout -------------------------------------------------
 
     def param_specs(self) -> PyTree:
-        """PartitionSpec per leaf — the model's sharding contract."""
+        """PartitionSpec per leaf — the model's sharding contract.
+
+        With ``pp > 1`` the per-layer trees are STACKED along a
+        leading ``n_layers`` dimension sharded over the ``pipe`` axis,
+        so each pipeline stage's device holds exactly its own
+        ``n_layers/pp`` consecutive layers (contiguous mesh reshape =
+        consecutive stages)."""
         layer = {
             "attn_norm": P(None),
             "wq": P(None, MODEL_AXIS),
@@ -164,9 +195,13 @@ class Llama(TMModel):
             "w_up": P(None, MODEL_AXIS),
             "w_down": P(MODEL_AXIS, None),
         }
+        if self.pp > 1:
+            layers = {k: P(PIPE_AXIS, *s) for k, s in layer.items()}
+        else:
+            layers = [dict(layer) for _ in range(self.n_layers)]
         return {
             "embed": P(MODEL_AXIS, None),        # vocab-sharded rows
-            "layers": [dict(layer) for _ in range(self.n_layers)],
+            "layers": layers,
             "final_norm": P(None),
             "lm_head": P(None, MODEL_AXIS),      # vocab-sharded cols
         }
@@ -197,6 +232,10 @@ class Llama(TMModel):
             })
             for _ in range(2):
                 next(keys)  # keep key budget aligned (9 per layer)
+        if self.pp > 1:
+            # stack the SAME per-layer draws (pp is a layout choice,
+            # not a math choice: init must match the pp=1 model)
+            layers = jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
         return {
             "embed": 0.02 * jax.random.normal(next(keys), (v, d), jnp.float32),
             "layers": layers,
@@ -243,7 +282,11 @@ class Llama(TMModel):
         return x
 
     def _forward(self, params, ids):
-        """ids [B_loc, T_loc] -> local vocab-shard logits [.., V/tp]."""
+        """ids [B_loc, T_loc] -> local vocab-shard logits [.., V/tp].
+
+        With ``pp > 1`` logits are VALID ON THE LAST PIPELINE STAGE
+        ONLY (other stages hold zeros-driven garbage); every metric
+        derived from them must go through ``_pp_value``."""
         cdtype = self.compute_dtype
         t_loc = ids.shape[1]
         seq_idx = lax.axis_index(SEQ_AXIS)
@@ -254,25 +297,55 @@ class Llama(TMModel):
         layer = self._layer
         if self.remat:
             layer = jax.checkpoint(layer)
-        for p in params["layers"]:
-            x = layer(p, x, pos)
+
+        if self.pp == 1:
+            for p in params["layers"]:
+                x = layer(p, x, pos)
+        else:
+            # GPipe over the pipe axis: the embed above is replicated
+            # compute (only stage 0's copy feeds the chain — backward
+            # through the stage-0 injection mask zeroes the rest), the
+            # blocks pipeline microbatch-wise, and the head below runs
+            # on every stage but is masked to the last by _pp_value
+            # (the where-transpose zeroes garbage-stage cotangents, so
+            # embed/head grads come back exact).
+            l_loc = self.n_layers // self.pp
+
+            def stage_fn(stage_params, xm):
+                for i in range(l_loc):
+                    p = jax.tree.map(lambda a: a[i], stage_params)
+                    xm = layer(p, xm, pos)
+                return xm
+
+            xmb = split_microbatches(x, self.pp_microbatches)
+            ys = pipeline_apply(stage_fn, params["layers"], xmb)
+            x = merge_microbatches(ys)
+
         x = rms_norm(x, params["final_norm"])
         return tp_lib.col_parallel(x, params["lm_head"]).astype(jnp.float32)
+
+    def _pp_value(self, v):
+        """Replicate a last-stage-only metric across pipeline stages
+        (identity when pp == 1)."""
+        return last_stage_value(v) if self.pp > 1 else v
 
     def _metrics(self, logits_loc, targets, top5: bool = False):
         """loss/top-1 (+ optional top-5, val-only: its candidate
         all_gathers are pure overhead on the train hot path)."""
         loss = tp_lib.sharded_softmax_xent(logits_loc, targets, self.vocab)
         err = tp_lib.sharded_top1_err(logits_loc, targets, self.vocab)
-        # average over the data/seq shards (each computed a local mean)
-        loss = lax.pmean(loss, (DATA_AXIS, SEQ_AXIS))
-        err = lax.pmean(err, (DATA_AXIS, SEQ_AXIS))
+        # average over the data/seq shards (each computed a local mean);
+        # with pp, keep only the last stage's value first
+        loss = lax.pmean(self._pp_value(loss), (DATA_AXIS, SEQ_AXIS))
+        err = lax.pmean(self._pp_value(err), (DATA_AXIS, SEQ_AXIS))
         if not top5:
             return loss, err
         err5 = tp_lib.sharded_topk_err(logits_loc, targets, self.vocab, k=5)
         # the model-axis pmean is a numerical no-op (every shard holds
         # the same gathered candidates) but marks err5 vma-invariant
-        err5 = lax.pmean(err5, (DATA_AXIS, SEQ_AXIS, MODEL_AXIS))
+        err5 = lax.pmean(
+            self._pp_value(err5), (DATA_AXIS, SEQ_AXIS, MODEL_AXIS)
+        )
         return loss, err, err5
 
     # -- contract ---------------------------------------------------------
@@ -310,12 +383,15 @@ class Llama(TMModel):
             exch_strategy or self.config.get("exch_strategy", "ici32")
         )
         if mesh is None:
-            mesh = make_mesh(model=self.tp, seq=self.sp)
+            mesh = make_mesh(model=self.tp, seq=self.sp, pipe=self.pp)
         self.mesh = mesh
         assert mesh.shape[MODEL_AXIS] == self.tp, (
             f"mesh model axis {mesh.shape[MODEL_AXIS]} != tp {self.tp}"
         )
         assert mesh.shape[SEQ_AXIS] == self.sp
+        assert mesh.shape.get(PIPE_AXIS, 1) == self.pp, (
+            f"mesh pipe axis {mesh.shape.get(PIPE_AXIS, 1)} != pp {self.pp}"
+        )
 
         specs = self.param_specs()
         # optimizer-state layout mirrors the params': adam m/v (t is
@@ -349,8 +425,8 @@ class Llama(TMModel):
                 # part of the model math
                 loss = tp_lib.sharded_softmax_xent(logits, y, self.vocab)
                 err = tp_lib.sharded_top1_err(logits, y, self.vocab)
-                loss = lax.pmean(loss, SEQ_AXIS)
-                err = lax.pmean(err, SEQ_AXIS)
+                loss = lax.pmean(self._pp_value(loss), SEQ_AXIS)
+                err = lax.pmean(self._pp_value(err), SEQ_AXIS)
                 return loss, err
 
             # check_vma=True autodiff returns exact grads for the TP/SP
@@ -419,6 +495,15 @@ class Llama(TMModel):
     @property
     def train_step_fn(self):
         return self._train_step
+
+    def train_step_cost_analysis(self):
+        """XLA ``cost_analysis()`` of the jitted train step (same
+        surface as ``ClassifierModel.train_step_cost_analysis``)."""
+        x, y = self.put_batch(self.data.train_batch(0))
+        return self._train_step.lower(
+            self.params, self.opt_state, x, y,
+            jnp.float32(self.current_lr),
+        ).compile().cost_analysis()
 
     def train_iter(self, count: int, recorder: Recorder) -> None:
         recorder.start()

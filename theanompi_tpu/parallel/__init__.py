@@ -14,7 +14,14 @@ from theanompi_tpu.parallel.mesh import (
     DATA_AXIS,
     MODEL_AXIS,
     SEQ_AXIS,
+    PIPE_AXIS,
     num_devices,
+)
+from theanompi_tpu.parallel.pp import (
+    pipeline_apply,
+    last_stage_value,
+    split_microbatches,
+    merge_microbatches,
 )
 from theanompi_tpu.parallel.exchange import (
     allreduce_mean,
@@ -39,7 +46,12 @@ __all__ = [
     "DATA_AXIS",
     "MODEL_AXIS",
     "SEQ_AXIS",
+    "PIPE_AXIS",
     "num_devices",
+    "pipeline_apply",
+    "last_stage_value",
+    "split_microbatches",
+    "merge_microbatches",
     "allreduce_mean",
     "elastic_pair_update",
     "elastic_center_merge",

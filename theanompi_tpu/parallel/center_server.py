@@ -61,6 +61,32 @@ def _to_host(tree: PyTree) -> list[np.ndarray]:
     return [np.asarray(x) for x in jax.tree.leaves(tree)]
 
 
+def _routable_host() -> str:
+    """Best-guess address other hosts can reach.
+
+    ``gethostbyname(gethostname())`` resolves to 127.0.x.1 on common
+    /etc/hosts layouts (Debian default maps the hostname to loopback),
+    which would make remote workers dial their OWN loopback.  The UDP
+    connect trick reads the outbound interface's address without
+    sending a packet; loopback-looking results fall back to it too.
+    """
+    try:
+        name_ip = socket.gethostbyname(socket.gethostname())
+    except OSError:
+        name_ip = ""
+    if name_ip and not name_ip.startswith("127."):
+        return name_ip
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("10.255.255.255", 1))  # never sent; routing only
+            ip = s.getsockname()[0]
+        if ip and not ip.startswith("127."):
+            return ip
+    except OSError:
+        pass
+    return name_ip or "127.0.0.1"
+
+
 class EASGDCenterServer:
     """Holds the center; serialises elastic exchanges (reference:
     EASGD_Server.run request loop).
@@ -76,7 +102,7 @@ class EASGDCenterServer:
     """
 
     def __init__(self, center: PyTree, alpha: float, host: str = "0.0.0.0",
-                 port: int = 0):
+                 port: int = 0, n_workers: int = 1):
         # np.array (copy): np.asarray on a jax.Array yields a READ-ONLY
         # view, and the elastic update mutates the center in place
         self._leaves = [np.array(l) for l in _to_host(center)]
@@ -85,13 +111,15 @@ class EASGDCenterServer:
         self._lock = threading.Lock()
         self.exchanges = 0
         self._stopped = threading.Event()
+        self.n_workers = int(n_workers)
+        self._stops = 0
+        self._all_stopped = threading.Event()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
         self._sock.listen(64)
         self.address = (
-            socket.gethostbyname(socket.gethostname())
-            if host == "0.0.0.0" else host,
+            _routable_host() if host == "0.0.0.0" else host,
             self._sock.getsockname()[1],
         )
         self._thread = threading.Thread(target=self._serve, daemon=True)
@@ -118,11 +146,22 @@ class EASGDCenterServer:
                 while True:
                     cmd, payload = _recv(conn)
                     if cmd == "exchange":
-                        _send(conn, self._exchange(payload))
+                        try:
+                            reply = self._exchange(payload)
+                        except ValueError as e:
+                            # reply instead of dying: a silent thread
+                            # death would leave the worker hung in
+                            # _recv forever
+                            reply = ("error", str(e))
+                        _send(conn, reply)
                     elif cmd == "get":
                         with self._lock:
                             _send(conn, [l.copy() for l in self._leaves])
                     elif cmd == "stop":
+                        with self._lock:
+                            self._stops += 1
+                            if self._stops >= self.n_workers:
+                                self._all_stopped.set()
                         _send(conn, "ok")
                         return
                     else:
@@ -133,6 +172,19 @@ class EASGDCenterServer:
     def _exchange(self, worker_leaves: list[np.ndarray]) -> list[np.ndarray]:
         a = self.alpha
         with self._lock:  # serialize: one worker at a time (reference)
+            if len(worker_leaves) != len(self._leaves):
+                raise ValueError(
+                    f"exchange: worker sent {len(worker_leaves)} leaves, "
+                    f"center has {len(self._leaves)} — worker model "
+                    f"config drifted from the center's"
+                )
+            for i, (c, w) in enumerate(zip(self._leaves, worker_leaves)):
+                if np.shape(w) != c.shape:
+                    raise ValueError(
+                        f"exchange: leaf {i} shape {np.shape(w)} != "
+                        f"center {c.shape} — worker model config "
+                        f"drifted from the center's"
+                    )
             pre = [l.copy() for l in self._leaves]
             for c, w in zip(self._leaves, worker_leaves):
                 diff = a * (np.asarray(w, c.dtype) - c)
@@ -147,6 +199,13 @@ class EASGDCenterServer:
             return jax.tree.unflatten(
                 self._treedef, [l.copy() for l in self._leaves]
             )
+
+    def wait_all_stopped(self, timeout: float = 300.0) -> bool:
+        """Block until every registered worker has sent 'stop' (or
+        timeout).  Process 0 must call this before tearing the server
+        down: exiting while slower workers still have exchanges
+        pending kills their connections mid-run."""
+        return self._all_stopped.wait(timeout)
 
     def stop(self) -> None:
         self._stopped.set()
@@ -169,6 +228,10 @@ class EASGDCenterClient:
         while True:
             try:
                 self._sock = socket.create_connection(address, timeout=60.0)
+                # connect timeout must NOT linger as a per-recv
+                # deadline: the server serializes exchanges, so a
+                # worker legitimately waits behind (N-1) peers
+                self._sock.settimeout(None)
                 return
             except OSError:
                 if time.monotonic() >= deadline:
@@ -176,9 +239,16 @@ class EASGDCenterClient:
                 time.sleep(delay)
                 delay = min(delay * 2, 2.0)
 
+    @staticmethod
+    def _check(reply):
+        if isinstance(reply, tuple) and len(reply) == 2 \
+                and reply[0] == "error":
+            raise RuntimeError(f"center server: {reply[1]}")
+        return reply
+
     def get(self, like: PyTree) -> PyTree:
         _send(self._sock, ("get", None))
-        leaves = _recv(self._sock)
+        leaves = self._check(_recv(self._sock))
         return jax.tree.unflatten(jax.tree.structure(like), leaves)
 
     def exchange(self, params: PyTree, alpha: float) -> PyTree:
@@ -186,7 +256,7 @@ class EASGDCenterClient:
         ``w - alpha*(w - c_pre)`` (the server applies its side)."""
         leaves = _to_host(params)
         _send(self._sock, ("exchange", leaves))
-        center_pre = _recv(self._sock)
+        center_pre = self._check(_recv(self._sock))
         new_leaves = [
             w - alpha * (w - np.asarray(c, w.dtype))
             for w, c in zip(leaves, center_pre)

@@ -28,6 +28,7 @@ from jax.sharding import Mesh
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
+PIPE_AXIS = "pipe"
 
 
 def default_devices() -> list[jax.Device]:
@@ -49,30 +50,38 @@ def make_mesh(
     data: int | None = None,
     model: int = 1,
     seq: int = 1,
+    pipe: int = 1,
     *,
     devices: Sequence[jax.Device] | None = None,
 ) -> Mesh:
-    """Build a ``Mesh`` with ``(data, model, seq)`` axes.
+    """Build a ``Mesh`` with ``(pipe, data, model, seq)`` axes.
 
-    ``data=None`` means "all remaining devices after model×seq".  On a
-    real slice the device order from ``jax.devices()`` already follows
-    the physical torus, so contiguous reshaping keeps the ``model`` and
-    ``seq`` axes on nearest-neighbour ICI links (these axes carry the
-    latency-sensitive collectives: TP psums and ring-attention
-    ppermutes), while ``data`` — bandwidth-bound but latency-tolerant
-    allreduces — spans the outer dimension.
+    ``data=None`` means "all remaining devices after pipe×model×seq".
+    On a real slice the device order from ``jax.devices()`` already
+    follows the physical torus, so contiguous reshaping keeps the
+    ``model`` and ``seq`` axes on nearest-neighbour ICI links (these
+    axes carry the latency-sensitive collectives: TP psums and
+    ring-attention ppermutes), while ``data`` — bandwidth-bound but
+    latency-tolerant allreduces — spans an outer dimension and
+    ``pipe`` — one activation hop per pipeline tick, the least
+    latency-sensitive traffic — spans the outermost (on a multi-host
+    pod it may even cross DCN).
     """
     devs = list(devices) if devices is not None else default_devices()
     n = len(devs)
-    if model * seq > n:
-        raise ValueError(f"model*seq={model * seq} exceeds {n} devices")
+    if pipe * model * seq > n:
+        raise ValueError(
+            f"pipe*model*seq={pipe * model * seq} exceeds {n} devices"
+        )
     if data is None:
-        data = n // (model * seq)
-    want = data * model * seq
+        data = n // (pipe * model * seq)
+    want = pipe * data * model * seq
     if want > n:
-        raise ValueError(f"mesh {data}x{model}x{seq}={want} exceeds {n} devices")
-    grid = np.array(devs[:want]).reshape(data, model, seq)
-    return Mesh(grid, (DATA_AXIS, MODEL_AXIS, SEQ_AXIS))
+        raise ValueError(
+            f"mesh {pipe}x{data}x{model}x{seq}={want} exceeds {n} devices"
+        )
+    grid = np.array(devs[:want]).reshape(pipe, data, model, seq)
+    return Mesh(grid, (PIPE_AXIS, DATA_AXIS, MODEL_AXIS, SEQ_AXIS))
 
 
 def data_axis(mesh: Mesh) -> int:

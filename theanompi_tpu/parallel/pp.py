@@ -30,11 +30,19 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-PIPE_AXIS = "pipe"
+from theanompi_tpu.parallel.mesh import PIPE_AXIS
 
 
 def stage_index(axis_name: str = PIPE_AXIS):
     return lax.axis_index(axis_name)
+
+
+def _pvary(x, axis_name: str):
+    """Idempotent invariant→varying cast (pcast rejects already-varying
+    inputs, and callers legitimately pass either)."""
+    if axis_name in jax.typeof(x).vma:
+        return x
+    return lax.pcast(x, (axis_name,), to="varying")
 
 
 def pipeline_apply(
@@ -62,10 +70,9 @@ def pipeline_apply(
 
     # the carry becomes stage-varying after one tick; mark it varying
     # up front so the scan types close (vma-checked shard_map)
-    ys0 = lax.pcast(jnp.zeros_like(x_microbatches), (axis_name,),
-                    to="varying")
-    recv0 = lax.pcast(jnp.zeros_like(x_microbatches[0]), (axis_name,),
-                      to="varying")
+    x_microbatches = _pvary(x_microbatches, axis_name)
+    ys0 = jnp.zeros_like(x_microbatches)
+    recv0 = jnp.zeros_like(x_microbatches[0])
 
     def tick(carry, t):
         recv, ys = carry

@@ -8,6 +8,7 @@ from theanompi_tpu.utils.checkpoint import (
     load_checkpoint,
     save_checkpoint,
 )
+from theanompi_tpu.utils.compile_cache import enable_compile_cache
 from theanompi_tpu.utils.recorder import Recorder
 from theanompi_tpu.utils.sharded_checkpoint import (
     is_sharded_checkpoint,
@@ -17,6 +18,7 @@ from theanompi_tpu.utils.sharded_checkpoint import (
 
 __all__ = [
     "Recorder",
+    "enable_compile_cache",
     "save_checkpoint",
     "load_checkpoint",
     "latest_checkpoint",

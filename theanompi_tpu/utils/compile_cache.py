@@ -1,0 +1,35 @@
+"""Persistent XLA compile-cache switch, shared by every entry point.
+
+One helper so the gate (``__graft_entry__``), the bench, and the test
+suite agree on the cache location and thresholds: repeat runs
+deserialize executables instead of recompiling (the flagship train
+step is a multi-minute compile), and ``TM_TEST_CACHE`` redirects all
+of them at once.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_compile_cache(default_dir: str | None = None) -> str | None:
+    """Point JAX's persistent compilation cache at ``TM_TEST_CACHE``
+    (env) or ``default_dir`` (fallback: ``.jax_cache`` next to the
+    repo root).  Returns the directory used, or None if the config
+    knobs are unavailable — the cache is an optimization, never a
+    failure."""
+    import jax
+
+    cache = os.environ.get("TM_TEST_CACHE")
+    if not cache:
+        cache = default_dir or os.path.join(
+            os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))),
+            ".jax_cache",
+        )
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        return None
+    return cache

@@ -128,12 +128,24 @@ def save_sharded_checkpoint(
         # marker — a checkpoint without the marker is never
         # discoverable (latest_checkpoint skips it), which restores
         # the npz path's "partial save is invisible" contract
-        final.mkdir(parents=True, exist_ok=True)
-        for f in tmp.iterdir():
-            os.replace(f, final / f.name)
-        tmp.rmdir()
         from jax.experimental import multihost_utils
 
+        # a same-step dir from an earlier run (e.g. resume after crash,
+        # possibly with a different process count) must be invalidated
+        # BEFORE anyone adds fresh files: drop the marker first (the
+        # old checkpoint becomes undiscoverable), then clear its stale
+        # shards/index fragments so the merged index cannot mix runs
+        multihost_utils.sync_global_devices("tm_tpu_sharded_ckpt_pre")
+        if pid == 0 and final.exists():
+            marker = final / _MARKER
+            if marker.exists():
+                marker.unlink()
+            shutil.rmtree(final)
+        multihost_utils.sync_global_devices("tm_tpu_sharded_ckpt_clear")
+        final.mkdir(parents=True, exist_ok=True)
+        for f in list(tmp.iterdir()):  # snapshot: renaming while
+            os.replace(f, final / f.name)  # iterating is unspecified
+        tmp.rmdir()
         multihost_utils.sync_global_devices("tm_tpu_sharded_ckpt")
         if pid == 0:
             (final / _MARKER).touch()

@@ -42,6 +42,31 @@ from theanompi_tpu.workers.bsp_worker import _build_mesh, _resolve_model
 from theanompi_tpu.workers.replica_engine import ReplicaEngine
 
 
+def _check_stability(
+    alpha: float, n_workers: int, allow_unstable: bool = False
+) -> None:
+    """Synchronous EASGD center step is c += sum_i alpha*(w_i - c);
+    the effective center rate beta = alpha*N must be <= 1 (Zhang et
+    al. 2015, §4 stability condition) or the center oscillates and
+    diverges.  Hard error by default: a diverging config would burn a
+    full run behind a warning that scrolls away.  Pass
+    ``allow_unstable=True`` in the config to proceed anyway (e.g. to
+    study the divergence)."""
+    if alpha * n_workers <= 1.0:
+        return
+    msg = (
+        f"EASGD alpha={alpha} with {n_workers} workers gives "
+        f"beta={alpha * n_workers:.2f} > 1: unstable. Use "
+        f"alpha <= {1.0 / n_workers:.4f}, or set "
+        f"allow_unstable=True to proceed anyway."
+    )
+    if not allow_unstable:
+        raise ValueError(msg)
+    import warnings
+
+    warnings.warn(msg, stacklevel=3)
+
+
 def run(
     devices: Sequence[Any] | None = None,
     modelfile: str = "",
@@ -83,6 +108,12 @@ def run(
     import jax as _jax
 
     if _jax.process_count() > 1:
+        if speeds is not None:
+            raise ValueError(
+                "speeds= is a single-controller knob (masked per-device "
+                "replicas); in multi-process mode each process already "
+                "runs at its own natural pace — drop the argument"
+            )
         return _run_distributed(
             modelfile=modelfile,
             modelclass=modelclass,
@@ -104,25 +135,14 @@ def run(
     cfg.update(extra)
     if n_epochs is not None:
         cfg["n_epochs"] = n_epochs
-    model = Model(cfg)
-    model.build_model(n_replicas=n_workers)
 
     alpha = float(alpha if alpha is not None
                   else cfg.get("alpha", 1.0 / n_workers))
     tau = int(tau if tau is not None else cfg.get("tau", 4))
-    if alpha * n_workers > 1.0:
-        # Synchronous EASGD center step is c += sum_i alpha*(w_i - c);
-        # the effective center rate beta = alpha*N must be <= 1 (Zhang
-        # et al. 2015, §4 stability condition) or the center oscillates
-        # and diverges.
-        import warnings
+    _check_stability(alpha, n_workers, cfg.get("allow_unstable", False))
 
-        warnings.warn(
-            f"EASGD alpha={alpha} with {n_workers} workers gives "
-            f"beta={alpha * n_workers:.2f} > 1: unstable. Use "
-            f"alpha <= {1.0 / n_workers:.4f}.",
-            stacklevel=2,
-        )
+    model = Model(cfg)
+    model.build_model(n_replicas=n_workers)
 
     recorder = Recorder(
         rank=0, size=n_workers, print_freq=print_freq, verbose=verbose
@@ -313,6 +333,7 @@ def _run_distributed(
     alpha = float(alpha if alpha is not None
                   else cfg.get("alpha", 1.0 / n_procs))
     tau = int(tau if tau is not None else cfg.get("tau", 4))
+    _check_stability(alpha, n_procs, cfg.get("allow_unstable", False))
 
     recorder = Recorder(
         rank=pid, size=n_procs, print_freq=print_freq, verbose=verbose
@@ -333,7 +354,8 @@ def _run_distributed(
             host, port = center_addr.rsplit(":", 1)
             port = int(port)
         server = EASGDCenterServer(
-            model.params, alpha, host=host, port=port
+            model.params, alpha, host=host, port=port,
+            n_workers=n_procs,
         )
         addr = f"{server.address[0]}:{server.address[1]}"
     if center_addr:
@@ -390,10 +412,30 @@ def _run_distributed(
             recorder.val_error(l, e, e5)
         recorder.end_epoch(epoch)
         model.adjust_hyperp(epoch + 1)
+        if server is not None and checkpoint_dir:
+            # per-epoch crash recovery, like the single-host path: the
+            # CENTER is the authoritative weights — stash the local
+            # replica, save the center snapshot, restore, train on
+            local_params = model.params
+            model.params = jax.device_put(
+                server.center_tree(),
+                jax.tree.map(lambda x: x.sharding, model.params),
+            )
+            model.save(checkpoint_dir, recorder)
+            model.params = local_params
         model.epoch += 1
 
+    # every worker (incl. process 0) announces completion; process 0
+    # keeps the server alive until ALL workers have — exiting earlier
+    # would kill slower workers' pending exchanges mid-run
     tcp.close()
     if server is not None:
+        if not server.wait_all_stopped(timeout=600.0) and verbose:
+            print(
+                "EASGD center: timed out waiting for all workers to "
+                "stop; shutting down anyway",
+                flush=True,
+            )
         # center owns the final weights + checkpoint (server semantics)
         center = server.center_tree()
         model.params = jax.device_put(

@@ -137,6 +137,26 @@ def run(
         send = jax.jit(gossip_send)
         deliver = jax.jit(gossip_deliver, donate_argnums=(0,))
         in_flight: "deque" = deque()  # (routing, params+opt snapshot)
+
+        def drain(scores):
+            """Deliver every payload still in flight (FIFO).  Senders
+            already halved their scores at send time, so an undelivered
+            payload is lost score mass — scores would no longer sum to
+            1 and _adopt_best would mis-weight; quiesce the wire before
+            any adopt/checkpoint (the reference's MPI analogue:
+            completing outstanding isends before a barrier)."""
+            while in_flight:
+                routing_d, snap_d = in_flight.popleft()
+                merged, scores = deliver(
+                    {"params": engine.params, "opt": engine.opt_state},
+                    scores, snap_d, routing_d,
+                )
+                engine.params = merged["params"]
+                engine.opt_state = merged["opt"]
+            return scores
+    else:
+        def drain(scores):
+            return scores
     host_rng = np.random.default_rng(
         seed if seed is not None else model.seed + 101
     )
@@ -198,7 +218,16 @@ def run(
                     )
                     # deep-copy the snapshot: the next train step
                     # DONATES engine.params/opt_state, which would
-                    # invalidate a bare reference held in the queue
+                    # invalidate a bare reference held in the queue.
+                    # Quiesce first: dispatching the copy program while
+                    # the train step's collectives are still running
+                    # can starve XLA:CPU's rendezvous on low-core hosts
+                    # (observed: 4/8 threads arrive, 40s termination
+                    # timeout, hard abort).  Value-read of the step's
+                    # loss output — not block_until_ready, which the
+                    # axon PJRT backend returns from early (see
+                    # models/base.py measurement note).
+                    _ = float(loss)
                     in_flight.append((routing, jax.tree.map(
                         jnp.copy,
                         {"params": engine.params, "opt": engine.opt_state},
@@ -228,10 +257,12 @@ def run(
         recorder.end_epoch(epoch)
         model.adjust_hyperp(epoch + 1)
         if checkpoint_dir:
+            scores = drain(scores)
             _adopt_best(model, engine, scores)
             model.save(checkpoint_dir, recorder)
         model.epoch += 1
 
+    scores = drain(scores)
     _adopt_best(model, engine, scores)
 
     last_val = recorder.val_records[-1] if recorder.val_records else {}
