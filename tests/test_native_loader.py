@@ -97,6 +97,51 @@ class TestNativeLoader:
         np.testing.assert_allclose(xv, 136.0)
         L.close()
 
+    def test_augmentation_identical_across_producers(self, tmb_files):
+        """ADVICE r1: the SAME logical batch must get the SAME
+        crops/flips whichever producer serves it — the C++ worker pool
+        and the pure-Python aug_rng derivation are bit-twins."""
+        from theanompi_tpu.models.data.aug_rng import crop_flip_draws
+
+        seed, epoch, crop = 11, 3, 12
+        L = self._loader(tmb_files, seed=seed, n_threads=2)
+        perm = np.array([1, 3, 0, 2], np.int32)
+        L.set_epoch(epoch, perm)
+        for seq in range(4):
+            x_native, y_native = L.next()
+            x_raw, y_raw = read_tmb(tmb_files[perm[seq]])
+            x_raw = np.asarray(x_raw, np.float32)
+            n, h, w, _ = x_raw.shape
+            ii, jj, flip = crop_flip_draws(
+                seed, epoch, seq, n, h, w, crop
+            )
+            ref = np.empty((n, crop, crop, 3), np.float32)
+            for k in range(n):
+                img = x_raw[k, ii[k]:ii[k] + crop, jj[k]:jj[k] + crop]
+                ref[k] = img[:, ::-1] if flip[k] else img
+            np.testing.assert_array_equal(np.asarray(x_native), ref)
+            np.testing.assert_array_equal(y_native, y_raw)
+        L.close()
+
+    def test_affinity_pins_workers(self, tmb_files, monkeypatch):
+        """SURVEY §2.1 CPU-binding row: TM_LOADER_AFFINITY pins the
+        worker pool; batches still arrive correctly."""
+        monkeypatch.setenv("TM_LOADER_AFFINITY", "0")
+        L = self._loader(tmb_files, n_threads=3)
+        assert L.pinned == 3
+        L.set_epoch(0)
+        x, y = L.next()
+        assert x.shape[0] == 6
+        L.close()
+
+    def test_bad_affinity_spec_pins_nothing(self, tmb_files, monkeypatch):
+        monkeypatch.setenv("TM_LOADER_AFFINITY", "not-cpus")
+        L = self._loader(tmb_files, n_threads=2)
+        assert L.pinned == 0
+        L.set_epoch(0)
+        L.next()
+        L.close()
+
     def test_open_rejects_inconsistent_files(self, tmp_path, rng):
         from theanompi_tpu.native import NativeBatchLoader
 

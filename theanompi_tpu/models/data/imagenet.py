@@ -148,14 +148,18 @@ class ImageNetData:
 
     # -- augmentation (reference: proc_load_mpi crop/flip/mean-sub) -------
 
-    def _augment(self, x: np.ndarray, seed: int) -> np.ndarray:
-        rng = np.random.default_rng(seed)
+    def _augment(self, x: np.ndarray, epoch: int, seq: int) -> np.ndarray:
+        """Crop+flip-mean with draws that are a pure function of
+        (seed, epoch, seq, image) — identical whichever producer runs
+        (``aug_rng`` twins the C++ loader's splitmix64 derivation)."""
+        from theanompi_tpu.models.data.aug_rng import crop_flip_draws
+
         n, h, w, _ = x.shape
         c = self.crop
         out = np.empty((n, c, c, 3), np.float32)
-        ii = rng.integers(0, h - c + 1, n)
-        jj = rng.integers(0, w - c + 1, n)
-        flip = rng.random(n) < 0.5
+        ii, jj, flip = crop_flip_draws(
+            self._seed, epoch, seq, n, h, w, c
+        )
         for k in range(n):
             img = x[k, ii[k] : ii[k] + c, jj[k] : jj[k] + c]
             out[k] = img[:, ::-1] if flip[k] else img
@@ -191,7 +195,7 @@ class ImageNetData:
         x, y = self._read_file(f)
         x = np.asarray(x, np.float32)
         self._check_batch(x, f)
-        x = self._augment(x, self._seed * 7 + self._epoch * 65537 + i)
+        x = self._augment(x, self._epoch, i)
         return x, np.asarray(y, np.int32)
 
     # -- async prefetch (proc_load_mpi equivalent) ------------------------

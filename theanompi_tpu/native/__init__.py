@@ -89,6 +89,8 @@ def load_native() -> ctypes.CDLL | None:
             np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
         ]
         lib.tm_loader_close.argtypes = [ctypes.c_void_p]
+        lib.tm_loader_pinned.restype = ctypes.c_int
+        lib.tm_loader_pinned.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
 
@@ -100,6 +102,12 @@ class NativeBatchLoader:
     ``set_epoch(epoch, perm)`` then ``next()`` exactly once per batch
     in order.  Augmentation (random crop + hflip − mean) runs in the
     C++ worker pool, deterministic per (seed, epoch, position).
+
+    ``TM_LOADER_AFFINITY`` pins worker threads to CPUs (SURVEY §2.1
+    "CPU binding / NUMA" row — the reference bound each rank's loader
+    to cores near its GPU): a list like ``"0-3,8"`` assigns worker i
+    to list[i % len]; ``"auto"`` spreads over all online CPUs.
+    ``pinned`` reports how many workers were actually pinned.
     """
 
     def __init__(
@@ -141,6 +149,11 @@ class NativeBatchLoader:
                 f"or bad crop {crop} for {h}x{w} images"
             )
         self.batch_shape = (int(n), crop, crop, int(c))
+
+    @property
+    def pinned(self) -> int:
+        """Worker threads successfully pinned (TM_LOADER_AFFINITY)."""
+        return int(self._lib.tm_loader_pinned(self._h)) if self._h else 0
 
     def set_epoch(self, epoch: int, perm: np.ndarray | None = None) -> None:
         if perm is None:

@@ -21,19 +21,72 @@
 // Exposed as a tiny C ABI consumed via ctypes (theanompi_tpu/native/
 // __init__.py) — no pybind11 dependency in this image.
 
+#include <pthread.h>
+#include <sched.h>
+#include <unistd.h>
+
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <map>
 #include <mutex>
-#include <random>
 #include <string>
 #include <thread>
 #include <vector>
 
 namespace {
+
+// CPU affinity for loader workers (SURVEY §2.1 "CPU binding / NUMA"
+// row; reference: per-rank core binding in the MPI launcher).
+// TM_LOADER_AFFINITY = "a,b,c-d,..." pins worker i to cpu list[i %
+// len]; "auto" spreads workers over all online CPUs.  Returns the
+// cpu list (empty = no pinning requested / parse failure).
+std::vector<int> affinity_cpus() {
+  const char* env = std::getenv("TM_LOADER_AFFINITY");
+  std::vector<int> cpus;
+  if (!env || !*env) return cpus;
+  if (std::strcmp(env, "auto") == 0) {
+    long n = sysconf(_SC_NPROCESSORS_ONLN);
+    for (long i = 0; i < n; ++i) cpus.push_back((int)i);
+    return cpus;
+  }
+  const char* p = env;
+  while (*p) {
+    char* end;
+    long a = std::strtol(p, &end, 10);
+    if (end == p) return {};  // malformed: pin nothing
+    long b = a;
+    p = end;
+    if (*p == '-') {
+      b = std::strtol(p + 1, &end, 10);
+      if (end == p + 1) return {};
+      p = end;
+    }
+    for (long v = a; v <= b; ++v) cpus.push_back((int)v);
+    if (*p == ',') ++p;
+  }
+  return cpus;
+}
+
+bool pin_thread(std::thread& t, int cpu) {
+  cpu_set_t set;
+  CPU_ZERO(&set);
+  CPU_SET(cpu, &set);
+  return pthread_setaffinity_np(t.native_handle(), sizeof(set), &set) == 0;
+}
+
+// Shared augmentation hash (public splitmix64 mixer): the Python
+// producer (models/data/aug_rng.py) implements the identical
+// function, so crops/flips agree bit-for-bit across producers.
+inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ULL;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+  return x ^ (x >> 31);
+}
 
 struct Header {
   int32_t n, h, w, c;
@@ -67,9 +120,16 @@ class Loader {
         mean_(std::move(mean)) {
     order_.resize(files_.size());
     for (size_t i = 0; i < order_.size(); ++i) order_[i] = (int)i;
-    for (int t = 0; t < n_threads; ++t)
+    const std::vector<int> cpus = affinity_cpus();
+    for (int t = 0; t < n_threads; ++t) {
       workers_.emplace_back([this] { worker(); });
+      if (!cpus.empty() &&
+          pin_thread(workers_.back(), cpus[t % cpus.size()]))
+        ++pinned_;
+    }
   }
+
+  int pinned() const { return pinned_; }
 
   ~Loader() {
     {
@@ -164,21 +224,23 @@ class Loader {
       if (!ok) return false;
     }
 
-    // deterministic per (seed, epoch, position-in-epoch)
-    std::mt19937_64 rng(seed_ ^ (0x9e3779b97f4a7c15ULL * (uint64_t)epoch) ^
-                        ((uint64_t)seq << 20));
+    // Augmentation draws are a PURE FUNCTION of (seed, epoch, seq, k)
+    // via splitmix64 — bit-identical to the Python producer
+    // (models/data/aug_rng.py), so the same logical batch gets the
+    // same crops/flips whichever path serves it.
     const int cr = crop_;
-    std::uniform_int_distribution<int> di(0, h.h - cr);
-    std::uniform_int_distribution<int> dj(0, h.w - cr);
-    std::uniform_int_distribution<int> dflip(0, 1);
-
     out->x.resize((size_t)h.n * cr * cr * h.c);
     out->y = std::move(labels);
     // mean_ is always a full [cr, cr, c] image (Python broadcasts
     // per-channel / scalar means before the call)
     for (int k = 0; k < h.n; ++k) {
-      const int i0 = di(rng), j0 = dj(rng);
-      const bool flip = dflip(rng) != 0;
+      const uint64_t base =
+          seed_ ^ (0x9e3779b97f4a7c15ULL * (uint64_t)epoch) ^
+          (0xbf58476d1ce4e5b9ULL * ((uint64_t)seq + 1)) ^
+          (0x94d049bb133111ebULL * ((uint64_t)k + 1));
+      const int i0 = (int)(splitmix64(base ^ 1) % (uint64_t)(h.h - cr + 1));
+      const int j0 = (int)(splitmix64(base ^ 2) % (uint64_t)(h.w - cr + 1));
+      const bool flip = (splitmix64(base ^ 3) & 1) != 0;
       const uint8_t* src = px.data() + (size_t)k * h.h * h.w * h.c;
       float* dst = out->x.data() + (size_t)k * cr * cr * h.c;
       for (int i = 0; i < cr; ++i) {
@@ -210,6 +272,7 @@ class Loader {
   std::map<long, Batch> ready_;
   long next_claim_ = 0, next_deliver_ = 0, generation_ = 0;
   int epoch_ = 0;
+  int pinned_ = 0;
   bool stop_ = false, failed_ = false;
 };
 
@@ -251,6 +314,11 @@ void tm_loader_set_epoch(void* handle, int epoch, const int32_t* perm,
 
 int tm_loader_next(void* handle, float* x_out, int32_t* y_out) {
   return static_cast<Loader*>(handle)->next(x_out, y_out);
+}
+
+// Worker threads successfully pinned to a CPU (TM_LOADER_AFFINITY).
+int tm_loader_pinned(void* handle) {
+  return static_cast<Loader*>(handle)->pinned();
 }
 
 void tm_loader_close(void* handle) { delete static_cast<Loader*>(handle); }

@@ -1,0 +1,53 @@
+"""Deterministic fault injection (SURVEY §5.3 failure recovery).
+
+The reference's failure story was "checkpoint every epoch, restart
+from the last one"; proving the rebuild honors it needs a
+reproducible mid-run death.  ``TM_FAULT_AT="<epoch>:<iter>"`` makes
+any worker loop die via ``os._exit(137)`` — no atexit, no buffered
+checkpoint flush, indistinguishable from a SIGKILL/preemption — right
+after that training iteration completes.
+
+Workers call ``maybe_inject_fault(epoch, i)`` once per iteration; the
+env read is cached so the hot loop pays one string compare.
+"""
+
+from __future__ import annotations
+
+import os
+
+_ENV = "TM_FAULT_AT"
+_parsed: tuple[int, int] | None | str = "unset"
+
+
+def _target() -> tuple[int, int] | None:
+    global _parsed
+    if _parsed == "unset":
+        raw = os.environ.get(_ENV)
+        if not raw:
+            _parsed = None
+        else:
+            try:
+                e, i = raw.split(":")
+                _parsed = (int(e), int(i))
+            except ValueError as err:
+                raise ValueError(
+                    f"{_ENV} must be '<epoch>:<iter>', got {raw!r}"
+                ) from err
+    return _parsed
+
+
+def maybe_inject_fault(epoch: int, i: int, i_last: int | None = None) -> None:
+    """Die like a preempted process if ``TM_FAULT_AT`` targets
+    ``epoch`` and an iteration in ``[i, i_last]`` (``i_last`` defaults
+    to ``i``; chunked dispatch loops pass the whole range so a target
+    inside a multi-step chunk still fires)."""
+    t = _target()
+    if t is None:
+        return
+    hi = i if i_last is None else i_last
+    if t[0] == epoch and i <= t[1] <= hi:
+        print(
+            f"TM_FAULT_AT: injecting fault at epoch {epoch} iter {t[1]}",
+            flush=True,
+        )
+        os._exit(137)

@@ -57,6 +57,7 @@ class Recorder:
         self._window: list[tuple[float, float]] = []  # (loss, err) since last print
         self._pending: list[tuple] = []  # unread device scalars (lazy fence)
         self.n_iter = 0
+        self._last_print = 0
 
     # -- wall-clock segments (reference: start()/end(mode)) ---------------
 
@@ -79,9 +80,13 @@ class Recorder:
         self.epoch_segments = {m: 0.0 for m in MODES}
 
     def train_error(self, count: int, loss, err) -> None:
-        """Record one iteration's (loss, err).
+        """Record one iteration's (loss, err) — or a CHUNK of
+        iterations when ``loss``/``err`` are length-K device vectors
+        (the multi-step scan path records all K in one call: one
+        async D2H per array instead of K sliced scalars, each of
+        which would be its own tiny device dispatch).
 
-        Accepts device scalars WITHOUT reading them — the read (which
+        Accepts device values WITHOUT reading them — the read (which
         is the device fence on this image's axon backend, see
         ``ClassifierModel.train_iter``) is deferred to the next print
         window / epoch end so the hot loop stays async and the device
@@ -97,15 +102,17 @@ class Recorder:
             if start is not None:
                 start()
         self._pending.append((loss, err))
-        self.n_iter += 1
+        self.n_iter += int(np.shape(loss)[0]) if np.ndim(loss) else 1
 
     def flush(self) -> None:
-        """Materialize pending device scalars (this is the fence)."""
+        """Materialize pending device values (this is the fence)."""
         for loss, err in self._pending:
-            l, e = float(loss), float(err)
-            self._train_losses.append(l)
-            self._train_errors.append(e)
-            self._window.append((l, e))
+            ls = np.asarray(loss, np.float64).ravel()
+            es = np.asarray(err, np.float64).ravel()
+            for l, e in zip(ls, es):
+                self._train_losses.append(float(l))
+                self._train_errors.append(float(e))
+                self._window.append((float(l), float(e)))
         self._pending = []
 
     @property
@@ -119,8 +126,12 @@ class Recorder:
         return self._train_errors
 
     def print_train_info(self, count: int) -> None:
-        if not self.verbose or count == 0 or count % self.print_freq:
+        # window boundary by RECORDED iteration count, not the caller's
+        # batch index: chunked dispatch loops pass strides of K, which
+        # with a modulo test could skip every boundary forever
+        if not self.verbose or self.n_iter < self._last_print + self.print_freq:
             return
+        self._last_print = self.n_iter
         # the flush below blocks until every step issued this window has
         # actually finished on device — attribute that wait to calc so
         # the window's calc figure is wall-clock-honest even though the
@@ -209,6 +220,7 @@ class Recorder:
         self.val_records = list(d["val_records"])
         self.epoch_times = list(d["epoch_times"])
         self.n_iter = int(d["n_iter"])
+        self._last_print = self.n_iter
 
     def load(self, path: str | Path) -> None:
         self.load_state_dict(json.loads(Path(path).read_text()))

@@ -1,0 +1,205 @@
+"""Profiler-trace-derived comm/calc attribution (SURVEY §5.1, §7).
+
+With the exchange fused INSIDE the jitted train step (the whole point
+of the TPU-native design), wall-clock fencing around host calls can no
+longer see communication: the Recorder's ``comm`` segment is
+structurally zero for BSP.  The honest split comes from the device
+trace: capture a ``jax.profiler`` trace of a few steps, parse the
+XLA op timeline per core, and classify op intervals as collective
+(all-reduce / all-gather / reduce-scatter / collective-permute /
+all-to-all / send / recv) or compute.
+
+The report is OVERLAP-AWARE: collective time that runs concurrently
+with compute on the same core is "hidden"; only collective time with
+no compute under it is "exposed" (what a user actually pays).  The
+reference measured comm by fencing MPI calls between train steps —
+here the equivalent number is ``exposed_comm_frac``.
+
+Parsing uses the ``xplane_pb2`` proto bundled with tensorflow (this
+image ships it); the import is lazy so the training path never pays
+for it.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Any, Callable, Iterable
+
+COLLECTIVE_MARKERS = (
+    "all-reduce",
+    "all-gather",
+    "reduce-scatter",
+    "collective-permute",
+    "all-to-all",
+    "collective-broadcast",
+    "ragged-all-to-all",
+    "send",
+    "recv",
+)
+
+
+def _xplane_pb2():
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    except ImportError as e:  # pragma: no cover
+        raise ImportError(
+            "trace parsing needs the xplane proto (bundled with "
+            "tensorflow on this image)"
+        ) from e
+    return xplane_pb2
+
+
+def capture_trace(fn: Callable[[], Any], trace_dir: str) -> Any:
+    """Run ``fn`` under ``jax.profiler.trace`` writing to
+    ``trace_dir``; returns ``fn``'s result."""
+    import jax
+
+    with jax.profiler.trace(trace_dir):
+        out = fn()
+        jax.block_until_ready(out) if out is not None else None
+    return out
+
+
+def _latest_xplanes(trace_dir: str) -> list[str]:
+    pattern = os.path.join(
+        trace_dir, "plugins", "profile", "*", "*.xplane.pb"
+    )
+    files = glob.glob(pattern)
+    if not files:
+        raise FileNotFoundError(
+            f"no xplane.pb under {trace_dir!r} (pattern {pattern})"
+        )
+    # newest run only (trace() creates a timestamped run dir per call)
+    runs: dict[str, list[str]] = {}
+    for f in files:
+        runs.setdefault(os.path.dirname(f), []).append(f)
+    latest = max(runs, key=os.path.getmtime)
+    return runs[latest]
+
+
+def is_collective(op_name: str) -> bool:
+    name = op_name.lower()
+    # fused collectives keep the collective op's name in the fusion
+    # name only for collective fusions; plain "fusion.N" is compute
+    return any(m in name for m in COLLECTIVE_MARKERS)
+
+
+def _merge_intervals(iv: list[tuple[int, int]]) -> list[tuple[int, int]]:
+    if not iv:
+        return []
+    iv.sort()
+    out = [iv[0]]
+    for s, e in iv[1:]:
+        if s <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], e))
+        else:
+            out.append((s, e))
+    return out
+
+
+def _span(iv: Iterable[tuple[int, int]]) -> int:
+    return sum(e - s for s, e in iv)
+
+
+def _subtract(a: list[tuple[int, int]],
+              b: list[tuple[int, int]]) -> list[tuple[int, int]]:
+    """Interval-set difference a - b (both merged/sorted)."""
+    out = []
+    bi = 0
+    for s, e in a:
+        cur = s
+        while bi < len(b) and b[bi][1] <= cur:
+            bi += 1
+        j = bi
+        while cur < e:
+            if j >= len(b) or b[j][0] >= e:
+                out.append((cur, e))
+                break
+            bs, be = b[j]
+            if bs > cur:
+                out.append((cur, bs))
+            cur = max(cur, be)
+            j += 1
+    return out
+
+
+def comm_report(trace_dir: str) -> dict:
+    """Parse the newest trace run under ``trace_dir`` into an
+    overlap-aware comm/compute attribution.
+
+    Returns per-core-aggregated::
+
+        {"device_busy_s", "collective_s", "exposed_comm_s",
+         "exposed_comm_frac", "hidden_comm_s", "comm_frac",
+         "n_cores", "top_collectives": [(name, seconds), ...]}
+    """
+    xplane_pb2 = _xplane_pb2()
+
+    # PER-CORE interval sets: an op timeline line is one core.  The
+    # hidden/exposed split must be computed on the SAME core — a
+    # collective stalling core A is exposed time even if core B is
+    # computing, so pooling cores before the subtraction would
+    # under-report exposure.  Totals are per-core sums (core-seconds).
+    cores: dict[tuple[int, str, int], dict[str, list]] = {}
+    per_op: dict[str, int] = {}
+
+    for pi, path in enumerate(_latest_xplanes(trace_dir)):
+        space = xplane_pb2.XSpace()
+        with open(path, "rb") as f:
+            space.ParseFromString(f.read())
+        for plane in space.planes:
+            name = plane.name
+            if not (name.startswith("/device:")
+                    or "TPU" in name or "XLA" in name):
+                continue
+            metadata = plane.event_metadata
+            for li, line in enumerate(plane.lines):
+                lname = (line.display_name or line.name or "").lower()
+                # the per-core op timeline; skip step/module/framework
+                # annotation lines which nest over the same span
+                if "xla ops" not in lname and lname != "ops":
+                    continue
+                # positional key: line ids are not guaranteed distinct
+                core = cores.setdefault(
+                    (pi, name, li), {"comm": [], "compute": []}
+                )
+                t0 = line.timestamp_ns
+                for ev in line.events:
+                    md = metadata.get(ev.metadata_id)
+                    op = md.name if md is not None else ""
+                    s = t0 * 1000 + ev.offset_ps
+                    e = s + ev.duration_ps
+                    if e <= s:
+                        continue
+                    if is_collective(op):
+                        core["comm"].append((s, e))
+                        per_op[op] = per_op.get(op, 0) + (e - s)
+                    else:
+                        core["compute"].append((s, e))
+
+    busy_ps = comm_ps = exposed_ps = 0
+    for core in cores.values():
+        comm_m = _merge_intervals(core["comm"])
+        compute_m = _merge_intervals(core["compute"])
+        busy_m = _merge_intervals(comm_m + compute_m)
+        exposed = _subtract(comm_m, compute_m)
+        busy_ps += _span(busy_m)
+        comm_ps += _span(comm_m)
+        exposed_ps += _span(exposed)
+
+    ps = 1e-12
+    busy_s = busy_ps * ps
+    comm_s = comm_ps * ps
+    exposed_s = exposed_ps * ps
+    top = sorted(per_op.items(), key=lambda kv: -kv[1])[:8]
+    return {
+        "device_busy_s": busy_s,
+        "collective_s": comm_s,
+        "exposed_comm_s": exposed_s,
+        "hidden_comm_s": comm_s - exposed_s,
+        "comm_frac": (comm_s / busy_s) if busy_s else 0.0,
+        "exposed_comm_frac": (exposed_s / busy_s) if busy_s else 0.0,
+        "n_cores": len(cores),
+        "top_collectives": [(k, v * ps) for k, v in top],
+    }

@@ -18,7 +18,7 @@ from typing import Any, Sequence
 
 from theanompi_tpu import launcher as _launcher
 from theanompi_tpu.parallel import make_mesh, default_devices
-from theanompi_tpu.utils import Recorder
+from theanompi_tpu.utils import Recorder, faults as _faults
 
 
 def _resolve_model(modelfile: str, modelclass: str):
@@ -85,9 +85,21 @@ def run(
         recorder.start_epoch()
         if hasattr(data, "shuffle"):
             data.shuffle(epoch)
-        for i in range(data.n_batch_train):
-            model.train_iter(i, recorder)
-            recorder.print_train_info(i)
+        nb = data.n_batch_train
+        i = 0
+        while i < nb:
+            # device-resident models batch K steps per dispatch
+            # (steps_per_call config knob); everything else is the
+            # classic one-step loop
+            k = model.preferred_chunk(nb - i) if hasattr(
+                model, "preferred_chunk") else 1
+            if k > 1:
+                model.train_chunk(i, k, recorder)
+            else:
+                model.train_iter(i, recorder)
+            i += k
+            recorder.print_train_info(i - 1)
+            _faults.maybe_inject_fault(epoch, i - k, i - 1)
 
         if data.n_batch_val:
             tot_l = tot_e = tot_e5 = 0.0

@@ -37,7 +37,7 @@ from theanompi_tpu.parallel import (
     elastic_center_merge,
     elastic_center_merge_masked,
 )
-from theanompi_tpu.utils import Recorder
+from theanompi_tpu.utils import Recorder, faults as _faults
 from theanompi_tpu.workers.bsp_worker import _build_mesh, _resolve_model
 from theanompi_tpu.workers.replica_engine import ReplicaEngine
 
@@ -251,6 +251,7 @@ def run(
                     since_exchange[exch] = 0
                     n_exchanges += int(exch.sum())
             recorder.print_train_info(i)
+            _faults.maybe_inject_fault(epoch, i)
 
         if data.n_batch_val:
             # server semantics: validate the CENTER weights
@@ -404,6 +405,7 @@ def _run_distributed(
                 recorder.end("comm")
                 n_exchanges += 1
             recorder.print_train_info(i)
+            _faults.maybe_inject_fault(epoch, i)
 
         if data.n_batch_val:
             vals = [model.val_iter(j, recorder)

@@ -36,7 +36,7 @@ import numpy as np
 
 from theanompi_tpu import launcher as _launcher
 from theanompi_tpu.parallel import gossip_matrix_round
-from theanompi_tpu.utils import Recorder
+from theanompi_tpu.utils import Recorder, faults as _faults
 from theanompi_tpu.workers.bsp_worker import _build_mesh, _resolve_model
 from theanompi_tpu.workers.replica_engine import ReplicaEngine
 
@@ -247,6 +247,7 @@ def run(
                 _ = float(scores[0])
                 recorder.end("comm")
             recorder.print_train_info(i)
+            _faults.maybe_inject_fault(epoch, i)
 
         if data.n_batch_val:
             # per-replica validation (reference: each process reports
