@@ -99,6 +99,26 @@ def _trace_comm(run_fn, extra: dict) -> None:
         pass  # attribution is diagnostic, never a bench failure
 
 
+def _chunked_runner(model, rec, nb: int):
+    """The worker's chunked dispatch loop (bsp_worker.run) as a bench
+    closure: whole scans via train_chunk, per-step tail via
+    train_iter.  One definition for both bench paths."""
+
+    def run_steps(n_steps: int) -> None:
+        i = 0
+        while i < n_steps:
+            pos = i % nb
+            k = model.preferred_chunk(nb - pos)
+            if k > 1:
+                model.train_chunk(pos, k, rec)
+                i += k
+            else:
+                model.train_iter(pos, rec)
+                i += 1
+
+    return run_steps
+
+
 def _emit(metric, value, unit, vs_baseline, extra=None):
     rec = {
         "metric": metric,
@@ -132,22 +152,26 @@ def bench_llama() -> None:
     cfg = dict(
         dim=1024, n_layers=8, n_heads=16, n_kv_heads=8, ffn_dim=2816,
         vocab=32000, seq_len=2048, batch_size=4, remat=True,
-        n_train=max(8 * 4 * n_chips, 64), n_val=8,
+        # 20 batches/epoch = 2 whole scans: the chunked loop must never
+        # fall into the (uncompiled) per-step tail inside the timed run
+        n_train=20 * 4 * n_chips, n_val=8,
         exch_strategy="ici16",
+        device_data_cache=True, steps_per_call=10,
     )
     model = Llama(cfg)
     model.build_model(n_replicas=n_chips)
     model.compile_iter_fns(mesh=make_mesh(data=n_chips, devices=devices))
 
     rec = Recorder(verbose=False)
-    model.train_iter(0, rec)   # compile
-    model.train_iter(1, rec)
+    nb = model.data.n_batch_train
+    run_steps = _chunked_runner(model, rec, nb)
+
+    run_steps(model.preferred_chunk(nb))  # compile
     rec.flush()
 
-    n_steps = 10
+    n_steps = 20
     t0 = time.perf_counter()
-    for i in range(n_steps):
-        model.train_iter(i % model.data.n_batch_train, rec)
+    run_steps(n_steps)
     rec.flush()  # value-read fence (see base.py measurement note)
     dt = time.perf_counter() - t0
 
@@ -156,12 +180,12 @@ def bench_llama() -> None:
 
     extra = {}
 
-    def _few_steps():
-        for i in range(3):
-            model.train_iter(i % model.data.n_batch_train, rec)
+    def _traced_chunk():
+        # trace the SAME executable the timed loop ran (already warm)
+        run_steps(model.preferred_chunk(nb))
         rec.flush()
 
-    _trace_comm(_few_steps, extra)
+    _trace_comm(_traced_chunk, extra)
     peak = _peak_flops(devices)
     flops = _step_flops(model, n_chips)
     if flops and peak:
@@ -202,9 +226,11 @@ def main() -> None:
     cfg["n_val"] = batch * n_chips
     # HBM-resident dataset: one staging transfer, per-step traffic is
     # the index vector only (essential on thin host↔device links);
-    # K steps ride each dispatch (scan) to amortize host latency
+    # K steps ride each dispatch (scan) to amortize host latency —
+    # K follows the epoch size so large slices (small nb_cap) still
+    # run whole scans instead of degrading to per-step dispatch
     cfg["device_data_cache"] = True
-    cfg.setdefault("steps_per_call", 20)
+    cfg.setdefault("steps_per_call", nb_cap)
     model = cls(cfg)
     model.build_model(n_replicas=n_chips)
     model.compile_iter_fns(mesh=mesh, exch_strategy="ici32")
@@ -213,18 +239,7 @@ def main() -> None:
     # dispatches the K-step scan, loss reads deferred to Recorder.flush
     rec = Recorder(verbose=False)
     nb = model.data.n_batch_train
-
-    def run_steps(n_steps: int) -> None:
-        i = 0
-        while i < n_steps:
-            pos = i % nb
-            k = model.preferred_chunk(nb - pos)
-            if k > 1:
-                model.train_chunk(pos, k, rec)
-                i += k
-            else:
-                model.train_iter(pos, rec)
-                i += 1
+    run_steps = _chunked_runner(model, rec, nb)
 
     run_steps(model.preferred_chunk(nb))  # compile scan path
     rec.flush()

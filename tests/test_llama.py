@@ -79,6 +79,27 @@ class TestLayoutInvariance:
             r1.train_losses, rp.train_losses, rtol=1e-3
         )
 
+    @pytest.mark.slow
+    def test_device_cache_scan_matches_per_step(self, devices8):
+        """The HBM-resident K-step scan path (device_data_cache +
+        steps_per_call) is the SAME math as per-step train_iter —
+        device-side batch indexing included."""
+        m1 = build(devices8, data=2, tp=2, sp=1, batch_size=2,
+                   optimizer="sgd", lr=0.3, n_train=32)
+        m2 = build(devices8, data=2, tp=2, sp=1, batch_size=2,
+                   optimizer="sgd", lr=0.3, n_train=32,
+                   device_data_cache=True, steps_per_call=4)
+        r1, r2 = Recorder(rank=0), Recorder(rank=0)
+        for i in range(4):
+            m1.train_iter(i, r1)
+        assert m2.preferred_chunk(8) == 4
+        m2.train_chunk(0, 4, r2)
+        r1.flush()
+        r2.flush()
+        np.testing.assert_allclose(
+            r1.train_losses, r2.train_losses, rtol=1e-4
+        )
+
     @pytest.mark.parametrize("sp_mode", ["ring", "ulysses"])
     @pytest.mark.slow
     def test_sgd_training_matches_across_meshes(self, devices8, sp_mode):

