@@ -74,6 +74,42 @@ class TMModel:
     def val_iter(self, count: int, recorder: Recorder):
         raise NotImplementedError
 
+    # -- device-resident multi-step dispatch (shared by the cached
+    # classifier and Llama paths; subclasses build _train_scan) ----------
+
+    _train_scan = None
+    _scan_k = 0
+
+    def preferred_chunk(self, remaining: int) -> int:
+        """Steps ``train_chunk`` should take in one dispatch: the
+        compiled scan length when the device-resident scan path is
+        live and fits in ``remaining``, else 1."""
+        if self._train_scan is not None and remaining >= self._scan_k:
+            return self._scan_k
+        return 1
+
+    def train_chunk(self, count: int, k: int, recorder: Recorder) -> None:
+        """Default: a per-step loop (scan-capable subclasses override
+        or dispatch through their compiled multi-step executable)."""
+        for j in range(k):
+            self.train_iter(count + j, recorder)
+
+    def _stage_cached_inputs(self) -> None:
+        """Restage the epoch permutation / lr when they changed — the
+        only host→device traffic on the device-resident path."""
+        rep = NamedSharding(self.mesh, P())
+        perm = self.data.epoch_permutation()
+        if perm is not self._perm_src:
+            self._perm_src = perm
+            self._perm_dev = jax.device_put(
+                jnp.asarray(perm, jnp.int32), rep
+            )
+        if self.current_lr != self._lr_val:
+            self._lr_val = self.current_lr
+            self._lr_dev = jax.device_put(
+                jnp.float32(self.current_lr), rep
+            )
+
     # -- schedules (reference: adjust_hyperp per model) -------------------
 
     def adjust_hyperp(self, epoch: int) -> None:
@@ -468,30 +504,6 @@ class ClassifierModel(TMModel):
                 jnp.float32(self.current_lr), self._rng,
             )
         return lowered.compile().cost_analysis()
-
-    def _stage_cached_inputs(self) -> None:
-        """Restage the epoch permutation / lr when they changed — the
-        only host→device traffic on the device-resident path."""
-        rep = NamedSharding(self.mesh, P())
-        perm = self.data.epoch_permutation()
-        if perm is not self._perm_src:
-            self._perm_src = perm
-            self._perm_dev = jax.device_put(
-                jnp.asarray(perm, jnp.int32), rep
-            )
-        if self.current_lr != self._lr_val:
-            self._lr_val = self.current_lr
-            self._lr_dev = jax.device_put(
-                jnp.float32(self.current_lr), rep
-            )
-
-    def preferred_chunk(self, remaining: int) -> int:
-        """Steps ``train_chunk`` should take in one dispatch: the
-        compiled scan length when the device-resident scan path is
-        live and fits in ``remaining``, else 1."""
-        if self._train_scan is not None and remaining >= self._scan_k:
-            return self._scan_k
-        return 1
 
     def train_chunk(self, count: int, k: int, recorder: Recorder) -> None:
         """Run steps ``count .. count+k-1``: ONE device dispatch when

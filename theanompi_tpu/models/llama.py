@@ -532,6 +532,11 @@ class Llama(TMModel):
         gb = int(self.data.global_batch)
         b_loc = int(self.config.get("batch_size", 8))
         t_loc = self.seq_len // self.sp
+        assert self.mesh.shape[DATA_AXIS] * b_loc == gb, (
+            f"device cache: mesh data axis {self.mesh.shape[DATA_AXIS]} "
+            f"x per-replica batch {b_loc} != global batch {gb} "
+            f"(build_model n_replicas must match the mesh)"
+        )
         specs, opt_specs = self._specs, self._opt_specs
         rep = NamedSharding(self.mesh, P())
 
@@ -590,26 +595,10 @@ class Llama(TMModel):
         self._perm_dev = None
         self._lr_val = None
         self._lr_dev = None
-        self._rep_sharding = rep
-
-    def preferred_chunk(self, remaining: int) -> int:
-        if self._train_scan is not None and remaining >= self._scan_k:
-            return self._scan_k
-        return 1
 
     def _scan_dispatch(self, scan_fn, count: int, recorder: Recorder):
         recorder.start()
-        perm = self.data.epoch_permutation()
-        if perm is not self._perm_src:
-            self._perm_src = perm
-            self._perm_dev = jax.device_put(
-                jnp.asarray(perm, jnp.int32), self._rep_sharding
-            )
-        if self.current_lr != self._lr_val:
-            self._lr_val = self.current_lr
-            self._lr_dev = jax.device_put(
-                jnp.float32(self.current_lr), self._rep_sharding
-            )
+        self._stage_cached_inputs()
         recorder.end("wait")
         recorder.start()
         (

@@ -400,18 +400,20 @@ def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
-    q, k, v, out, lse = res
+def _flash_bwd_call(
+    q, k, v, g, lse, delta, causal, sm_scale, block_q, block_k, interpret
+):
+    """Backward kernels against EXPLICIT (lse, delta) residuals
+    ([B,H,T,1] fp32).  Factored out of ``_flash_bwd`` so ring
+    attention can run the same kernels per visiting KV block with the
+    GLOBAL logsumexp/delta (the standard ring-attention backward)."""
     b, h, t, t_k, d, block_q, block_k = _flash_dims(q, k, block_q, block_k)
     qs = q.reshape(b * h, t, d)
     ks = k.reshape(b * h, t_k, d)
     vs = v.reshape(b * h, t_k, d)
     dos = g.reshape(b * h, t, d)
-    # delta_i = rowsum(dO * O): the softmax-jacobian correction term
-    delta = jnp.sum(
-        dos.astype(jnp.float32) * out.reshape(b * h, t, d).astype(jnp.float32),
-        axis=-1, keepdims=True,
-    )                                             # [bh, t, 1], like lse
+    lse = lse.reshape(b * h, t, 1)
+    delta = delta.reshape(b * h, t, 1)
     vma = jax.typeof(qs).vma
     q_spec = pl.BlockSpec((1, block_q, d), lambda i, kj, qi: (i, qi, 0))
     k_spec = pl.BlockSpec((1, block_k, d), lambda i, kj, qi: (i, kj, 0))
@@ -460,6 +462,19 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
     )
 
 
+def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse = res
+    # delta_i = rowsum(dO * O): the softmax-jacobian correction term
+    delta = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32),
+        axis=-1, keepdims=True,
+    )                                             # [b, h, t, 1], like lse
+    return _flash_bwd_call(
+        q, k, v, g, lse.reshape(q.shape[:3] + (1,)), delta,
+        causal, sm_scale, block_q, block_k, interpret,
+    )
+
+
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
@@ -468,7 +483,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
     static_argnames=("causal", "sm_scale", "block_q", "block_k", "interpret"),
 )
 def flash_attention_tpu(
-    q, k, v, *, causal=True, sm_scale=None, block_q=1024, block_k=1024,
+    q, k, v, *, causal=True, sm_scale=None, block_q=None, block_k=None,
     interpret=False,
 ):
     """Fused flash attention, fully differentiable (custom_vjp with
@@ -484,6 +499,13 @@ def flash_attention_tpu(
         )
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
+    # default blocks: largest that tile this T (fall back to the old
+    # 256 default for lengths no power-of-two divides — _flash_dims
+    # then raises its clear divisibility error)
+    if block_q is None:
+        block_q = _auto_block(q.shape[2]) or 256
+    if block_k is None:
+        block_k = _auto_block(k.shape[2]) or 256
     return _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret)
 
 

@@ -30,10 +30,163 @@ import jax.numpy as jnp
 from jax import lax
 
 from theanompi_tpu.ops.attention import (
+    _HAVE_PALLAS,
+    _auto_block,
+    _flash_bwd_call,
+    _flash_fwd_call,
+    _on_tpu,
     block_attn_finish,
     block_attn_init,
     block_attn_update,
 )
+
+
+def _rep(x, r: int):
+    return jnp.repeat(x, r, axis=1) if r != 1 else x
+
+
+def _unrep(dx, r: int):
+    """Fold full-head grads back onto compact GQA heads (transpose of
+    ``_rep``: the repeated groups' grads sum)."""
+    if r == 1:
+        return dx
+    b, hr, t, d = dx.shape
+    return dx.reshape(b, hr // r, r, t, d).sum(axis=2)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _ring_flash(q, k, v, axis_name, causal, sm_scale, kv_rep, block,
+                interpret):
+    o, _ = _ring_flash_fwd(
+        q, k, v, axis_name, causal, sm_scale, kv_rep, block, interpret
+    )
+    return o
+
+
+def _hop_masks(my_idx, src, s_size, causal):
+    """(is_diag, visible) for the block that started at ``src``.
+    ``is_diag`` routes to the causal kernel — only meaningful under
+    causality (a non-causal diagonal block is just a full block)."""
+    is_diag = jnp.logical_and(jnp.asarray(causal), src == my_idx)
+    visible = jnp.logical_or(
+        jnp.asarray(not causal), src <= my_idx
+    )
+    return is_diag, visible
+
+
+def _ring_flash_fwd(q, k, v, axis_name, causal, sm_scale, kv_rep, block,
+                    interpret):
+    """Per-hop Pallas flash fwd + online logsumexp merge.
+
+    The hop triad under causality: the diagonal block (started here)
+    is causal flash, earlier blocks are full flash, future blocks are
+    computed-but-masked (SPMD: every device must run the same
+    program; the dense path wastes the same flops).
+    """
+    b, h, t_loc, d = q.shape
+    s_size = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % s_size) for i in range(s_size)]
+
+    def hop_fwd(is_diag, k_use, v_use):
+        return lax.cond(
+            is_diag,
+            lambda a, bb, c: _flash_fwd_call(
+                a, bb, c, True, sm_scale, block, block, interpret
+            ),
+            lambda a, bb, c: _flash_fwd_call(
+                a, bb, c, False, sm_scale, block, block, interpret
+            ),
+            q, k_use, v_use,
+        )
+
+    m = jnp.full((b, h, t_loc, 1), -jnp.inf, jnp.float32)
+    num = jnp.zeros((b, h, t_loc, d), jnp.float32)
+    den = jnp.zeros((b, h, t_loc, 1), jnp.float32)
+    k_cur, v_cur = k, v
+    for step in range(s_size):
+        src = (my_idx - step) % s_size
+        is_diag, visible = _hop_masks(my_idx, src, s_size, causal)
+        o_i, lse_i = hop_fwd(is_diag, _rep(k_cur, kv_rep),
+                             _rep(v_cur, kv_rep))
+        lse_i = lse_i.reshape(b, h, t_loc, 1)
+        # merge: future blocks weigh 0; exp(m - m_new) is 0 on the
+        # first (always-visible diagonal) fold, so no -inf arithmetic
+        lse_eff = jnp.where(visible, lse_i, -jnp.inf)
+        m_new = jnp.maximum(m, lse_eff)
+        alpha = jnp.exp(m - m_new)
+        w = jnp.where(visible, jnp.exp(lse_i - m_new), 0.0)
+        num = num * alpha + w * o_i.astype(jnp.float32)
+        den = den * alpha + w
+        m = m_new
+        if step != s_size - 1:
+            k_cur, v_cur = jax.tree.map(
+                lambda x: lax.ppermute(x, axis_name, perm),
+                (k_cur, v_cur),
+            )
+    o = (num / jnp.maximum(den, 1e-30)).astype(q.dtype)
+    lse_global = m + jnp.log(jnp.maximum(den, 1e-30))
+    return o, (q, k, v, o, lse_global)
+
+
+def _ring_flash_bwd(axis_name, causal, sm_scale, kv_rep, block,
+                    interpret, res, g):
+    """Ring backward: each hop runs the flash dQ and dK/dV kernels
+    against the GLOBAL (lse, delta) residuals; dK/dV accumulators
+    circulate WITH the KV blocks, so after the full ring each block's
+    gradient arrives home with all devices' contributions summed."""
+    q, k, v, o, lse = res
+    s_size = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % s_size) for i in range(s_size)]
+    delta = jnp.sum(
+        g.astype(jnp.float32) * o.astype(jnp.float32),
+        axis=-1, keepdims=True,
+    )
+
+    def hop_bwd(is_diag, k_use, v_use):
+        return lax.cond(
+            is_diag,
+            lambda a, bb: _flash_bwd_call(
+                q, a, bb, g, lse, delta, True, sm_scale, block, block,
+                interpret,
+            ),
+            lambda a, bb: _flash_bwd_call(
+                q, a, bb, g, lse, delta, False, sm_scale, block, block,
+                interpret,
+            ),
+            k_use, v_use,
+        )
+
+    dq = jnp.zeros_like(q, jnp.float32)
+    k_cur, v_cur = k, v
+    dk_cur = jnp.zeros_like(k, jnp.float32)
+    dv_cur = jnp.zeros_like(v, jnp.float32)
+    for step in range(s_size):
+        src = (my_idx - step) % s_size
+        is_diag, visible = _hop_masks(my_idx, src, s_size, causal)
+        dq_i, dk_i, dv_i = hop_bwd(
+            is_diag, _rep(k_cur, kv_rep), _rep(v_cur, kv_rep)
+        )
+        dq = dq + jnp.where(visible, dq_i.astype(jnp.float32), 0.0)
+        dk_cur = dk_cur + jnp.where(
+            visible, _unrep(dk_i.astype(jnp.float32), kv_rep), 0.0
+        )
+        dv_cur = dv_cur + jnp.where(
+            visible, _unrep(dv_i.astype(jnp.float32), kv_rep), 0.0
+        )
+        # rotate EVERY step (s rotations total): the accumulators ride
+        # the full ring and land back on their block's owner
+        k_cur, v_cur, dk_cur, dv_cur = jax.tree.map(
+            lambda x: lax.ppermute(x, axis_name, perm),
+            (k_cur, v_cur, dk_cur, dv_cur),
+        )
+    return (
+        dq.astype(q.dtype), dk_cur.astype(k.dtype), dv_cur.astype(v.dtype)
+    )
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
 
 
 def ring_attention(
@@ -45,6 +198,8 @@ def ring_attention(
     causal: bool = True,
     sm_scale: float | None = None,
     kv_rep: int = 1,
+    impl: str | None = None,
+    interpret: bool = False,
 ) -> jnp.ndarray:
     """Attention over a sequence sharded on ``axis_name``.
 
@@ -56,10 +211,35 @@ def ring_attention(
     ring in that compact form (the expensive part — ppermute bytes on
     the ICI seq axis); each fold repeats the *visiting* block up to H
     heads locally, which is free relative to the hop it avoids fattening.
+
+    ``impl``: ``"flash"`` folds each visiting block with the Pallas
+    kernels (per-hop flash + logsumexp merge; backward rides the flash
+    backward kernels with global residuals, accumulating dK/dV around
+    the ring) — scores never materialize in HBM.  ``"dense"`` is the
+    jnp online-softmax path.  Default: flash on TPU when the shard
+    length blocks, else dense.
     """
     b, h, t_loc, d = q.shape
     if sm_scale is None:
         sm_scale = d**-0.5
+    if impl is None:
+        impl = (
+            "flash"
+            if (_HAVE_PALLAS and _on_tpu(q) and _auto_block(t_loc))
+            else "dense"
+        )
+    if impl == "flash":
+        block = _auto_block(t_loc)
+        if block is None:
+            raise ValueError(
+                f"impl='flash' needs a blockable shard length; "
+                f"T_loc={t_loc} has no power-of-two kernel block "
+                f"(use impl='dense' or pad the sequence)"
+            )
+        return _ring_flash(
+            q, k, v, axis_name, causal, sm_scale, kv_rep, block,
+            interpret,
+        )
     s_size = lax.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     q_pos = my_idx * t_loc + jnp.arange(t_loc) if causal else None
