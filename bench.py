@@ -203,7 +203,8 @@ def bench_llama() -> None:
 def main() -> None:
     import os
 
-    if os.environ.get("TM_BENCH_MODEL", "").lower() == "llama":
+    which = os.environ.get("TM_BENCH_MODEL", "").lower()
+    if which == "llama":
         bench_llama()
         return
     from theanompi_tpu.models import load_flagship
@@ -215,13 +216,22 @@ def main() -> None:
     n_chips = len(devices)
     mesh = make_mesh(data=n_chips, devices=devices)
 
-    modelfile, modelclass, cls, cfg, batch = load_flagship()
+    if which == "wresnet":
+        # secondary classifier metric: WRN-28-10 CIFAR shapes
+        from theanompi_tpu.models.wresnet import WResNet
+
+        modelfile, modelclass = "theanompi_tpu.models.wresnet", "WResNet"
+        cls, batch = WResNet, 256
+        cfg = {"batch_size": batch, "depth": 28, "widen": 10}
+        img_bytes = 32 * 32 * 3 * 2           # CIFAR bf16
+    else:
+        modelfile, modelclass, cls, cfg, batch = load_flagship()
+        img_bytes = 224 * 224 * 3 * 2         # ImageNet-shape bf16
     # 20 batches per epoch (chunked dispatch below always runs whole
     # scans, never a ragged tail) — but cap the HBM dataset cache: it
     # is REPLICATED per device, so letting it scale with chip count
     # would OOM large slices; fewer batches just means epochs recycle
-    # 224x224x3 bf16 = 301056 bytes/image in the cache
-    nb_cap = max(2, min(20, (2 << 30) // (batch * n_chips * 301_056)))
+    nb_cap = max(2, min(20, (2 << 30) // (batch * n_chips * img_bytes)))
     cfg["n_train"] = nb_cap * batch * n_chips
     cfg["n_val"] = batch * n_chips
     # HBM-resident dataset: one staging transfer, per-step traffic is

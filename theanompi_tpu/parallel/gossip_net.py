@@ -1,0 +1,126 @@
+"""Peer-to-peer gossip transport for multi-process GoSGD.
+
+Reference: ``theanompi/gosgd_worker.py`` ran one worker per MPI
+process; a push was an ``isend`` of ``(params, score/2)`` to a random
+peer, and every iteration each worker ``probe``d for arrivals and
+merged whatever had landed — pushes rode the wire while both sides
+kept training.
+
+TPU-native shape: each PROCESS is one gossip worker over its local
+chips.  This module is the wire: every peer runs a listener thread
+that enqueues arriving pushes, and a single sender thread drains an
+outbound queue over short-lived TCP connections (fire-and-forget, the
+``isend`` analogue — a dead receiver costs a logged drop, never a
+training stall).  Peer addresses travel through the ``jax.distributed``
+KV store, the same bootstrap transport the coordinator uses.
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import threading
+from typing import Any
+
+from theanompi_tpu.parallel.center_server import (
+    _recv,
+    _routable_host,
+    _send,
+)
+
+PyTree = Any
+
+
+class GossipPeer:
+    """One process's gossip endpoint: listener + async sender."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.address = (
+            _routable_host() if host == "0.0.0.0" else host,
+            self._sock.getsockname()[1],
+        )
+        self._inbox: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._outbox: "queue.Queue" = queue.Queue()
+        self._stopped = threading.Event()
+        self.sent = 0
+        self.received = 0
+        self.dropped = 0
+        self._listener = threading.Thread(target=self._listen, daemon=True)
+        self._listener.start()
+        self._sender = threading.Thread(target=self._drain, daemon=True)
+        self._sender.start()
+
+    # -- receive side -----------------------------------------------------
+
+    def _listen(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                self._sock.settimeout(0.2)
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(
+                target=self._ingest, args=(conn,), daemon=True
+            ).start()
+
+    def _ingest(self, conn: socket.socket) -> None:
+        try:
+            with conn:
+                payload = _recv(conn)
+                self._inbox.put(payload)
+                self.received += 1
+        except (ConnectionError, EOFError, OSError):
+            return
+
+    def poll(self) -> list[tuple[float, list]]:
+        """All pushes that have arrived since the last poll (the
+        reference's probe loop) — [(score, leaves), ...]."""
+        out = []
+        while True:
+            try:
+                out.append(self._inbox.get_nowait())
+            except queue.Empty:
+                return out
+
+    # -- send side --------------------------------------------------------
+
+    def push(self, addr: tuple[str, int], score: float, leaves: list) -> None:
+        """Queue a push; the sender thread ships it without blocking
+        training (isend semantics)."""
+        self._outbox.put((addr, (float(score), leaves)))
+
+    def _drain(self) -> None:
+        while True:
+            item = self._outbox.get()
+            if item is None:
+                return
+            addr, payload = item
+            try:
+                with socket.create_connection(addr, timeout=30.0) as s:
+                    _send(s, payload)
+                self.sent += 1
+            except OSError:
+                self.dropped += 1  # dead peer: drop, keep training
+            finally:
+                self._outbox.task_done()
+
+    def flush(self, timeout: float = 60.0) -> None:
+        """Block until queued pushes have left this host (call before
+        the end-of-run barrier so no payload is abandoned locally)."""
+        t = threading.Thread(target=self._outbox.join, daemon=True)
+        t.start()
+        t.join(timeout)
+
+    def close(self) -> None:
+        self._stopped.set()
+        self._outbox.put(None)
+        try:
+            self._sock.close()
+        except OSError:
+            pass

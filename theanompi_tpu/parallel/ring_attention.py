@@ -63,23 +63,19 @@ def _ring_flash(q, k, v, axis_name, causal, sm_scale, kv_rep, block,
     return o
 
 
-def _hop_masks(my_idx, src, s_size, causal):
-    """(is_diag, visible) for the block that started at ``src``.
-    ``is_diag`` routes to the causal kernel — only meaningful under
-    causality (a non-causal diagonal block is just a full block)."""
-    is_diag = jnp.logical_and(jnp.asarray(causal), src == my_idx)
-    visible = jnp.logical_or(
-        jnp.asarray(not causal), src <= my_idx
-    )
-    return is_diag, visible
+def _hop_visible(my_idx, src, causal):
+    """Whether the block that started at ``src`` is (at all) visible
+    to this device's queries under causality."""
+    return jnp.logical_or(jnp.asarray(not causal), src <= my_idx)
 
 
 def _ring_flash_fwd(q, k, v, axis_name, causal, sm_scale, kv_rep, block,
                     interpret):
     """Per-hop Pallas flash fwd + online logsumexp merge.
 
-    The hop triad under causality: the diagonal block (started here)
-    is causal flash, earlier blocks are full flash, future blocks are
+    The hop triad under causality: the diagonal block — which is
+    STATICALLY hop 0 (src == my_idx iff step == 0) — is causal flash,
+    earlier blocks are full flash, future blocks are
     computed-but-masked (SPMD: every device must run the same
     program; the dense path wastes the same flops).
     """
@@ -88,27 +84,17 @@ def _ring_flash_fwd(q, k, v, axis_name, causal, sm_scale, kv_rep, block,
     my_idx = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % s_size) for i in range(s_size)]
 
-    def hop_fwd(is_diag, k_use, v_use):
-        return lax.cond(
-            is_diag,
-            lambda a, bb, c: _flash_fwd_call(
-                a, bb, c, True, sm_scale, block, block, interpret
-            ),
-            lambda a, bb, c: _flash_fwd_call(
-                a, bb, c, False, sm_scale, block, block, interpret
-            ),
-            q, k_use, v_use,
-        )
-
     m = jnp.full((b, h, t_loc, 1), -jnp.inf, jnp.float32)
     num = jnp.zeros((b, h, t_loc, d), jnp.float32)
     den = jnp.zeros((b, h, t_loc, 1), jnp.float32)
     k_cur, v_cur = k, v
     for step in range(s_size):
         src = (my_idx - step) % s_size
-        is_diag, visible = _hop_masks(my_idx, src, s_size, causal)
-        o_i, lse_i = hop_fwd(is_diag, _rep(k_cur, kv_rep),
-                             _rep(v_cur, kv_rep))
+        visible = _hop_visible(my_idx, src, causal)
+        o_i, lse_i = _flash_fwd_call(
+            q, _rep(k_cur, kv_rep), _rep(v_cur, kv_rep),
+            causal and step == 0, sm_scale, block, block, interpret,
+        )
         lse_i = lse_i.reshape(b, h, t_loc, 1)
         # merge: future blocks weigh 0; exp(m - m_new) is 0 on the
         # first (always-visible diagonal) fold, so no -inf arithmetic
@@ -144,29 +130,16 @@ def _ring_flash_bwd(axis_name, causal, sm_scale, kv_rep, block,
         axis=-1, keepdims=True,
     )
 
-    def hop_bwd(is_diag, k_use, v_use):
-        return lax.cond(
-            is_diag,
-            lambda a, bb: _flash_bwd_call(
-                q, a, bb, g, lse, delta, True, sm_scale, block, block,
-                interpret,
-            ),
-            lambda a, bb: _flash_bwd_call(
-                q, a, bb, g, lse, delta, False, sm_scale, block, block,
-                interpret,
-            ),
-            k_use, v_use,
-        )
-
     dq = jnp.zeros_like(q, jnp.float32)
     k_cur, v_cur = k, v
     dk_cur = jnp.zeros_like(k, jnp.float32)
     dv_cur = jnp.zeros_like(v, jnp.float32)
     for step in range(s_size):
         src = (my_idx - step) % s_size
-        is_diag, visible = _hop_masks(my_idx, src, s_size, causal)
-        dq_i, dk_i, dv_i = hop_bwd(
-            is_diag, _rep(k_cur, kv_rep), _rep(v_cur, kv_rep)
+        visible = _hop_visible(my_idx, src, causal)
+        dq_i, dk_i, dv_i = _flash_bwd_call(
+            q, _rep(k_cur, kv_rep), _rep(v_cur, kv_rep), g, lse, delta,
+            causal and step == 0, sm_scale, block, block, interpret,
         )
         dq = dq + jnp.where(visible, dq_i.astype(jnp.float32), 0.0)
         dk_cur = dk_cur + jnp.where(

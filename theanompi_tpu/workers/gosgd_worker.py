@@ -27,6 +27,7 @@ between degenerate one-class predictors.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Any, Sequence
 
@@ -82,6 +83,27 @@ def run(
     arrived stale while both peers kept training; this knob reproduces
     that staleness deterministically (sender still halves its score at
     send time)."""
+    import jax as _jax
+
+    if _jax.process_count() > 1:
+        if staleness not in (None, 0):
+            raise ValueError(
+                "staleness= is a single-controller knob (deterministic "
+                "delayed delivery); in multi-process mode arrivals are "
+                "as stale as the wire made them — drop the argument"
+            )
+        return _run_distributed(
+            modelfile=modelfile,
+            modelclass=modelclass,
+            config={**(config or {}), **extra},
+            push_prob=push_prob,
+            n_epochs=n_epochs,
+            checkpoint_dir=checkpoint_dir,
+            resume=resume,
+            print_freq=print_freq,
+            verbose=verbose,
+            seed=seed,
+        )
     mesh = _build_mesh(devices)
     n_workers = mesh.shape["data"]
     if n_workers < 2:
@@ -271,6 +293,236 @@ def run(
         "epochs": model.epoch,
         "iterations": recorder.n_iter,
         "gossip_rounds": n_rounds,
+        "final_train_loss": (
+            recorder.train_losses[-1] if recorder.train_losses else None
+        ),
+        "final_val": last_val,
+        "epoch_times": recorder.epoch_times,
+        "recorder": recorder,
+        "model": model,
+    }
+
+
+# advances once per _run_distributed call, in lockstep across the
+# processes of a distributed session (they all call run() the same
+# number of times in a sweep) — isolates each run's KV keys
+_DIST_RUN_COUNTER = 0
+
+
+def _run_distributed(
+    *,
+    modelfile: str,
+    modelclass: str,
+    config: dict,
+    push_prob: float | None,
+    n_epochs: int | None,
+    checkpoint_dir: str | None,
+    resume: bool,
+    print_freq: int,
+    verbose: bool,
+    seed: int | None,
+) -> dict:
+    """Multi-process GoSGD: each PROCESS is one gossip worker over its
+    local chips (reference: one worker per MPI rank).  Pushes are
+    fire-and-forget TCP sends to a random peer (``gossip_net`` — the
+    isend analogue); each iteration the worker polls its inbox and
+    merges whatever arrived, score-weighted.  No barrier anywhere in
+    training: arrivals are as stale as the wire made them, exactly the
+    reference's asynchrony."""
+    from jax._src import distributed as _dist
+
+    from theanompi_tpu.parallel import make_mesh
+    from theanompi_tpu.parallel.gossip_net import GossipPeer
+
+    pid = jax.process_index()
+    n_procs = jax.process_count()
+    local = jax.local_devices()
+    mesh = make_mesh(data=len(local), devices=local)
+
+    Model = _resolve_model(modelfile, modelclass)
+    cfg = dict(config)
+    if n_epochs is not None:
+        cfg["n_epochs"] = n_epochs
+    model = Model(cfg)
+    model.build_model(n_replicas=len(local))
+    model.compile_iter_fns(mesh=mesh)
+
+    p_push = float(
+        push_prob if push_prob is not None else cfg.get("push_prob", 0.25)
+    )
+    recorder = Recorder(
+        rank=pid, size=n_procs, print_freq=print_freq, verbose=verbose
+    )
+    if resume and checkpoint_dir:
+        # shared filesystem (standard pod setup): everyone restarts
+        # from the adopted-best weights of the previous run
+        if model.load(checkpoint_dir, recorder):
+            model.epoch += 1
+
+    # peer bootstrap over the jax.distributed KV store.  The nonce
+    # makes repeat run() calls in one distributed session (parameter
+    # sweeps) use fresh keys — every process's counter advances in
+    # lockstep since they all call run() the same number of times.
+    global _DIST_RUN_COUNTER
+    _DIST_RUN_COUNTER += 1
+    tag = f"{os.environ.get('TM_RUN_ID', '0')}_{_DIST_RUN_COUNTER}"
+    peer = GossipPeer()
+    kv = _dist.global_state.client
+    kv.key_value_set(f"tm_gosgd_{tag}_peer_{pid}",
+                     f"{peer.address[0]}:{peer.address[1]}")
+    peers: dict[int, tuple[str, int]] = {}
+    for r in range(n_procs):
+        if r == pid:
+            continue
+        a = kv.blocking_key_value_get(f"tm_gosgd_{tag}_peer_{r}", 60000)
+        host, port = a.rsplit(":", 1)
+        peers[r] = (host, int(port))
+
+    # score-weighted merge of an arriving snapshot into the local pair
+    # (a is a RUNTIME scalar: merge weights change every delivery and
+    # must not retrace)
+    @partial(jax.jit, donate_argnums=(0,))
+    def merge(mine, theirs, a):
+        return jax.tree.map(
+            lambda x, y: (a * x.astype(jnp.float32)
+                          + (1.0 - a) * y.astype(jnp.float32)).astype(x.dtype),
+            mine, theirs,
+        )
+
+    def snapshot_host():
+        return jax.tree.map(
+            lambda x: np.asarray(x),
+            {"params": model.params, "opt": model.opt_state},
+        )
+
+    host_rng = np.random.default_rng(
+        (seed if seed is not None else model.seed + 211) + pid * 7919
+    )
+    score = 1.0 / n_procs
+    n_pushes = 0
+    n_merges = 0
+    sent_to = {r: 0 for r in peers}  # per-destination, for the ack
+    data = model.data
+    if verbose and pid == 0:
+        print(
+            f"GoSGD(distributed): {n_procs} worker processes x "
+            f"{len(local)} chips, p={p_push}",
+            flush=True,
+        )
+
+    def drain_inbox(score):
+        nonlocal n_merges
+        for s_in, leaves in peer.poll():
+            theirs = jax.tree.unflatten(
+                jax.tree.structure(
+                    {"params": model.params, "opt": model.opt_state}
+                ),
+                leaves,
+            )
+            a = score / (score + s_in)
+            merged = merge(
+                {"params": model.params, "opt": model.opt_state},
+                theirs, jnp.float32(a),
+            )
+            model.params = merged["params"]
+            model.opt_state = merged["opt"]
+            score += s_in
+            n_merges += 1
+        return score
+
+    while model.epoch < model.n_epochs:
+        epoch = model.epoch
+        recorder.start_epoch()
+        if hasattr(data, "shuffle"):
+            data.shuffle(epoch + pid * 104729)  # decorrelate worker data
+        for i in range(data.n_batch_train):
+            model.train_iter(i, recorder)
+            # probe-and-merge whatever the wire delivered (reference:
+            # per-iteration MPI probe loop)
+            recorder.start()
+            score = drain_inbox(score)
+            if host_rng.random() < p_push:
+                dst = int(host_rng.integers(0, n_procs - 1))
+                dst += dst >= pid  # peer != self
+                recorder.flush()  # fence: snapshot AFTER the step
+                snap = snapshot_host()
+                score *= 0.5
+                peer.push(peers[dst], score, jax.tree.leaves(snap))
+                sent_to[dst] += 1
+                n_pushes += 1
+            recorder.end("comm")
+            recorder.print_train_info(i)
+            _faults.maybe_inject_fault(epoch, i)
+
+        if data.n_batch_val:
+            vals = [model.val_iter(j, recorder)
+                    for j in range(data.n_batch_val)]
+            l, e, e5 = (float(sum(v) / len(v)) for v in zip(*vals))
+            recorder.val_error(l, e, e5)
+        recorder.end_epoch(epoch)
+        model.adjust_hyperp(epoch + 1)
+        if checkpoint_dir and pid == 0:
+            # per-epoch crash recovery (single-process path saves the
+            # best replica; mid-run there is no global score view, and
+            # reference semantics say ANY worker's weights are the
+            # model — process 0's replica is the epoch checkpoint)
+            model.save(checkpoint_dir, recorder)
+        model.epoch += 1
+
+    # quiesce: ship queued pushes, publish per-destination send counts,
+    # then every process drains its inbox until it has received exactly
+    # what the senders addressed to it — a receive-side ack, so no
+    # score mass is abandoned on the wire (flush() only guarantees the
+    # bytes LEFT the sender)
+    peer.flush()
+    import json as _json
+    import time as _time
+
+    kv.key_value_set(f"tm_gosgd_{tag}_sent_{pid}",
+                     _json.dumps({str(r): c for r, c in sent_to.items()}))
+    expected = 0
+    for r in range(n_procs):
+        if r == pid:
+            continue
+        counts = _json.loads(
+            kv.blocking_key_value_get(f"tm_gosgd_{tag}_sent_{r}", 120000)
+        )
+        expected += int(counts.get(str(pid), 0))
+    deadline = _time.monotonic() + 120.0
+    score = drain_inbox(score)
+    while n_merges < expected and _time.monotonic() < deadline:
+        _time.sleep(0.05)
+        score = drain_inbox(score)
+    if n_merges < expected and verbose:
+        print(
+            f"GoSGD quiesce: received {n_merges}/{expected} pushes "
+            f"before timeout",
+            flush=True,
+        )
+
+    kv.key_value_set(f"tm_gosgd_{tag}_done_{pid}", f"{score:.9e}")
+    final_scores = {}
+    for r in range(n_procs):
+        final_scores[r] = float(
+            kv.blocking_key_value_get(f"tm_gosgd_{tag}_done_{r}", 120000)
+        )
+
+    if checkpoint_dir:
+        # reference semantics: the best worker's weights are the model;
+        # the highest post-drain score saves the final checkpoint
+        best = max(final_scores, key=lambda r: final_scores[r])
+        if pid == best:
+            model.save(checkpoint_dir, recorder)
+    peer.close()
+
+    last_val = recorder.val_records[-1] if recorder.val_records else {}
+    return {
+        "epochs": model.epoch,
+        "iterations": recorder.n_iter,
+        "pushes": n_pushes,
+        "merges": n_merges,
+        "score": score,
+        "process_index": pid,
         "final_train_loss": (
             recorder.train_losses[-1] if recorder.train_losses else None
         ),
