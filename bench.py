@@ -200,12 +200,63 @@ def bench_llama() -> None:
     )
 
 
+def bench_loader() -> None:
+    """Input-pipeline metric (TM_BENCH_MODEL=loader): C++ .tmb loader
+    throughput — read + crop/flip/mean-subtract + ordered delivery
+    (SURVEY §7 hard part: the input pipeline must feed chips at
+    O(100k) img/s per pod; this measures one host's engine)."""
+    import os
+    import tempfile
+
+    import numpy as np
+
+    from theanompi_tpu.native import NativeBatchLoader, load_native, write_tmb
+
+    if load_native() is None:
+        print(json.dumps({"metric": "loader", "error": "no toolchain"}))
+        return
+    batch, hw, crop, n_files = 128, 256, 224, 16
+    rng = np.random.default_rng(0)
+    with tempfile.TemporaryDirectory() as td:
+        files = []
+        for i in range(n_files):
+            x = rng.integers(0, 256, (batch, hw, hw, 3)).astype(np.uint8)
+            y = np.arange(batch, dtype=np.int32)
+            p = os.path.join(td, f"b{i}.tmb")
+            write_tmb(p, x, y)
+            files.append(p)
+        n_threads = int(os.environ.get("TM_LOADER_THREADS", 4))
+        L = NativeBatchLoader(
+            files, crop=crop, mean=np.zeros((1, 1, 3), np.float32),
+            depth=4, n_threads=n_threads,
+        )
+        L.set_epoch(0)
+        L.next()  # warm the pool
+        L.set_epoch(1)
+        t0 = time.perf_counter()
+        for _ in range(n_files):
+            L.next()
+        dt = time.perf_counter() - t0
+        L.close()
+    per_sec = n_files * batch / dt
+    _emit(
+        f"native .tmb loader images/sec ({n_threads} threads, "
+        f"{hw}->{crop} crop+flip-mean)",
+        per_sec,
+        "images/sec",
+        _vs_baseline("Loader_images_per_sec", per_sec),
+    )
+
+
 def main() -> None:
     import os
 
     which = os.environ.get("TM_BENCH_MODEL", "").lower()
     if which == "llama":
         bench_llama()
+        return
+    if which == "loader":
+        bench_loader()
         return
     from theanompi_tpu.models import load_flagship
     from theanompi_tpu.parallel import default_devices, make_mesh

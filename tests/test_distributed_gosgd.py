@@ -37,7 +37,7 @@ CHILD = textwrap.dedent(
         push_prob=0.6, seed=pid * 13 + 5,
         verbose=False,
     )
-    print(f"RESULT {{pid}} {{out['pushes']}} {{out['merges']}} "
+    print(f"RESULT {{pid}} {{out['delivered']}} {{out['merges']}} "
           f"{{out['score']:.6f}} {{out['final_train_loss']:.6f}}",
           flush=True)
     """
@@ -85,18 +85,18 @@ def test_two_process_gosgd(tmp_path):
     for out in outs:
         for line in out.splitlines():
             if line.startswith("RESULT"):
-                _, pid, pushes, merges, score, loss = line.split()
+                _, pid, delivered, merges, score, loss = line.split()
                 results[pid] = (
-                    int(pushes), int(merges), float(score), float(loss)
+                    int(delivered), int(merges), float(score), float(loss)
                 )
     assert set(results) == {"0", "1"}, outs
-    total_pushes = sum(r[0] for r in results.values())
+    total_delivered = sum(r[0] for r in results.values())
     total_merges = sum(r[1] for r in results.values())
-    assert total_pushes >= 2, results     # gossip actually happened
-    # every push that was sent got merged somewhere (quiesce drained
-    # the wire before the processes compared notes)
-    assert total_merges == total_pushes, results
-    for pid, (pushes, merges, score, loss) in results.items():
+    assert total_delivered >= 2, results  # gossip actually happened
+    # every payload that LEFT a sender got merged somewhere (the
+    # receive-side ack drained the wire before notes were compared)
+    assert total_merges == total_delivered, results
+    for pid, (delivered, merges, score, loss) in results.items():
         assert np.isfinite(loss), results
         assert 0.0 < score < 1.0, results
     # score mass is conserved across the cluster (sends halve, merges

@@ -32,9 +32,18 @@ PyTree = Any
 
 
 class GossipPeer:
-    """One process's gossip endpoint: listener + async sender."""
+    """One process's gossip endpoint: listener + async sender.
 
-    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+    The outbox is BOUNDED (``max_pending`` full snapshots): if pushes
+    outpace the wire, the oldest queued payload is dropped — matching
+    the fire-and-forget semantics — instead of growing host memory by
+    a params+opt copy per push.  ``sent_counts`` tallies per
+    destination only what actually LEFT this host, so end-of-run
+    accounting (the receive-side ack) never waits for a payload that
+    was dropped."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0,
+                 max_pending: int = 8):
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -44,11 +53,12 @@ class GossipPeer:
             self._sock.getsockname()[1],
         )
         self._inbox: "queue.SimpleQueue" = queue.SimpleQueue()
-        self._outbox: "queue.Queue" = queue.Queue()
+        self._outbox: "queue.Queue" = queue.Queue(maxsize=max_pending)
         self._stopped = threading.Event()
         self.sent = 0
         self.received = 0
         self.dropped = 0
+        self.sent_counts: dict[tuple[str, int], int] = {}
         self._listener = threading.Thread(target=self._listen, daemon=True)
         self._listener.start()
         self._sender = threading.Thread(target=self._drain, daemon=True)
@@ -92,19 +102,33 @@ class GossipPeer:
 
     def push(self, addr: tuple[str, int], score: float, leaves: list) -> None:
         """Queue a push; the sender thread ships it without blocking
-        training (isend semantics)."""
-        self._outbox.put((addr, (float(score), leaves)))
+        training (isend semantics).  A full outbox drops the OLDEST
+        queued payload."""
+        item = (addr, (float(score), leaves))
+        while True:
+            try:
+                self._outbox.put_nowait(item)
+                return
+            except queue.Full:
+                try:
+                    self._outbox.get_nowait()
+                    self._outbox.task_done()
+                    self.dropped += 1
+                except queue.Empty:
+                    continue
 
     def _drain(self) -> None:
         while True:
             item = self._outbox.get()
             if item is None:
+                self._outbox.task_done()
                 return
             addr, payload = item
             try:
                 with socket.create_connection(addr, timeout=30.0) as s:
                     _send(s, payload)
                 self.sent += 1
+                self.sent_counts[addr] = self.sent_counts.get(addr, 0) + 1
             except OSError:
                 self.dropped += 1  # dead peer: drop, keep training
             finally:

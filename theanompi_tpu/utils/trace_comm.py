@@ -143,6 +143,7 @@ def comm_report(trace_dir: str) -> dict:
     # under-report exposure.  Totals are per-core sums (core-seconds).
     cores: dict[tuple[int, str, int], dict[str, list]] = {}
     per_op: dict[str, int] = {}
+    per_op_all: dict[str, int] = {}
 
     for pi, path in enumerate(_latest_xplanes(trace_dir)):
         space = xplane_pb2.XSpace()
@@ -172,6 +173,7 @@ def comm_report(trace_dir: str) -> dict:
                     e = s + ev.duration_ps
                     if e <= s:
                         continue
+                    per_op_all[op] = per_op_all.get(op, 0) + (e - s)
                     if is_collective(op):
                         core["comm"].append((s, e))
                         per_op[op] = per_op.get(op, 0) + (e - s)
@@ -188,7 +190,7 @@ def comm_report(trace_dir: str) -> dict:
         comm_ps += _span(comm_m)
         exposed_ps += _span(exposed)
 
-    ps = 1e-12
+    ps = 1e-12  # durations are picoseconds in the xplane
     busy_s = busy_ps * ps
     comm_s = comm_ps * ps
     exposed_s = exposed_ps * ps
@@ -202,4 +204,41 @@ def comm_report(trace_dir: str) -> dict:
         "exposed_comm_frac": (exposed_s / busy_s) if busy_s else 0.0,
         "n_cores": len(cores),
         "top_collectives": [(k, v * ps) for k, v in top],
+        "top_ops": [
+            (k, v * ps)
+            for k, v in sorted(per_op_all.items(), key=lambda kv: -kv[1])[:15]
+        ],
     }
+
+
+def _main(argv) -> int:
+    """CLI: ``python -m theanompi_tpu.utils.trace_comm <trace_dir>`` —
+    print the overlap-aware comm/compute attribution + top ops of the
+    newest profiler run under ``trace_dir``."""
+    if len(argv) != 1:
+        print("usage: python -m theanompi_tpu.utils.trace_comm "
+              "<trace_dir>")
+        return 2
+    rep = comm_report(argv[0])
+    print(f"device busy       {rep['device_busy_s']:.4f} core-seconds "
+          f"({rep['n_cores']} op timelines)")
+    print(f"collective        {rep['collective_s']:.4f}s "
+          f"({rep['comm_frac']:.1%} of busy)")
+    print(f"  exposed         {rep['exposed_comm_s']:.4f}s "
+          f"({rep['exposed_comm_frac']:.1%} of busy)")
+    print(f"  hidden          {rep['hidden_comm_s']:.4f}s")
+    if rep["top_collectives"]:
+        print("top collectives:")
+        for name, sec in rep["top_collectives"]:
+            print(f"  {sec * 1e3:9.2f} ms  {name[:70]}")
+    print("top ops:")
+    busy = rep["device_busy_s"] or 1.0
+    for name, sec in rep["top_ops"]:
+        print(f"  {sec / busy:6.1%} {sec * 1e3:9.2f} ms  {name[:70]}")
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    import sys
+
+    raise SystemExit(_main(sys.argv[1:]))

@@ -401,7 +401,6 @@ def _run_distributed(
     score = 1.0 / n_procs
     n_pushes = 0
     n_merges = 0
-    sent_to = {r: 0 for r in peers}  # per-destination, for the ack
     data = model.data
     if verbose and pid == 0:
         print(
@@ -448,7 +447,6 @@ def _run_distributed(
                 snap = snapshot_host()
                 score *= 0.5
                 peer.push(peers[dst], score, jax.tree.leaves(snap))
-                sent_to[dst] += 1
                 n_pushes += 1
             recorder.end("comm")
             recorder.print_train_info(i)
@@ -469,26 +467,37 @@ def _run_distributed(
             model.save(checkpoint_dir, recorder)
         model.epoch += 1
 
-    # quiesce: ship queued pushes, publish per-destination send counts,
-    # then every process drains its inbox until it has received exactly
-    # what the senders addressed to it — a receive-side ack, so no
-    # score mass is abandoned on the wire (flush() only guarantees the
-    # bytes LEFT the sender)
+    # quiesce: ship queued pushes, publish per-destination DELIVERED
+    # counts (what actually left this host — a queued-then-dropped
+    # payload must not be awaited), then every process drains its
+    # inbox until it has received exactly what was addressed to it —
+    # a receive-side ack, so no score mass is abandoned on the wire
+    # (flush() only guarantees the bytes LEFT the sender).  The KV
+    # waits scale with the run: the no-barrier design means worker
+    # skew grows with training length (TM_GOSGD_QUIESCE_S overrides).
     peer.flush()
     import json as _json
     import time as _time
 
+    wall = sum(recorder.epoch_times) or 60.0
+    quiesce_s = float(os.environ.get(
+        "TM_GOSGD_QUIESCE_S", max(600.0, 2.0 * wall)
+    ))
+    kv_ms = int(quiesce_s * 1000)
+    delivered = {
+        r: peer.sent_counts.get(addr, 0) for r, addr in peers.items()
+    }
     kv.key_value_set(f"tm_gosgd_{tag}_sent_{pid}",
-                     _json.dumps({str(r): c for r, c in sent_to.items()}))
+                     _json.dumps({str(r): c for r, c in delivered.items()}))
     expected = 0
     for r in range(n_procs):
         if r == pid:
             continue
         counts = _json.loads(
-            kv.blocking_key_value_get(f"tm_gosgd_{tag}_sent_{r}", 120000)
+            kv.blocking_key_value_get(f"tm_gosgd_{tag}_sent_{r}", kv_ms)
         )
         expected += int(counts.get(str(pid), 0))
-    deadline = _time.monotonic() + 120.0
+    deadline = _time.monotonic() + quiesce_s
     score = drain_inbox(score)
     while n_merges < expected and _time.monotonic() < deadline:
         _time.sleep(0.05)
@@ -504,7 +513,7 @@ def _run_distributed(
     final_scores = {}
     for r in range(n_procs):
         final_scores[r] = float(
-            kv.blocking_key_value_get(f"tm_gosgd_{tag}_done_{r}", 120000)
+            kv.blocking_key_value_get(f"tm_gosgd_{tag}_done_{r}", kv_ms)
         )
 
     if checkpoint_dir:
@@ -520,6 +529,7 @@ def _run_distributed(
         "epochs": model.epoch,
         "iterations": recorder.n_iter,
         "pushes": n_pushes,
+        "delivered": sum(delivered.values()),
         "merges": n_merges,
         "score": score,
         "process_index": pid,
