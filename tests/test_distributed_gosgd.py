@@ -61,6 +61,9 @@ def test_two_process_gosgd(tmp_path):
         JAX_PLATFORMS="cpu",
         XLA_FLAGS="--xla_force_host_platform_device_count=2",
         TM_TPU_PLATFORM="cpu",
+        # keep worst-case quiesce inside the subprocess timeout so a
+        # lost delivery fails with diagnostics, not TimeoutExpired
+        TM_GOSGD_QUIESCE_S="60",
     )
     procs = [
         subprocess.Popen(

@@ -54,6 +54,7 @@ class GossipPeer:
         )
         self._inbox: "queue.SimpleQueue" = queue.SimpleQueue()
         self._outbox: "queue.Queue" = queue.Queue(maxsize=max_pending)
+        self._refunds: "queue.SimpleQueue" = queue.SimpleQueue()
         self._stopped = threading.Event()
         self.sent = 0
         self.received = 0
@@ -103,7 +104,9 @@ class GossipPeer:
     def push(self, addr: tuple[str, int], score: float, leaves: list) -> None:
         """Queue a push; the sender thread ships it without blocking
         training (isend semantics).  A full outbox drops the OLDEST
-        queued payload."""
+        queued payload — its score mass goes to the refund queue (the
+        sender halved its score at push time; un-merged mass must
+        return home or the cluster's scores stop summing to 1)."""
         item = (addr, (float(score), leaves))
         while True:
             try:
@@ -111,11 +114,22 @@ class GossipPeer:
                 return
             except queue.Full:
                 try:
-                    self._outbox.get_nowait()
+                    _, (old_score, _leaves) = self._outbox.get_nowait()
                     self._outbox.task_done()
                     self.dropped += 1
+                    self._refunds.put(old_score)
                 except queue.Empty:
                     continue
+
+    def take_refunds(self) -> float:
+        """Score mass from dropped payloads, to add back to the local
+        worker's score (drain alongside ``poll``)."""
+        total = 0.0
+        while True:
+            try:
+                total += self._refunds.get_nowait()
+            except queue.Empty:
+                return total
 
     def _drain(self) -> None:
         while True:
@@ -130,20 +144,44 @@ class GossipPeer:
                 self.sent += 1
                 self.sent_counts[addr] = self.sent_counts.get(addr, 0) + 1
             except OSError:
-                self.dropped += 1  # dead peer: drop, keep training
+                self.dropped += 1  # dead peer: refund, keep training
+                self._refunds.put(payload[0])
             finally:
                 self._outbox.task_done()
 
-    def flush(self, timeout: float = 60.0) -> None:
+    def flush(self, timeout: float = 60.0) -> bool:
         """Block until queued pushes have left this host (call before
-        the end-of-run barrier so no payload is abandoned locally)."""
+        the end-of-run barrier so no payload is abandoned locally).
+        Returns False if the budget expired with work still queued —
+        the caller must then treat ``sent_counts`` as a floor, not a
+        total."""
         t = threading.Thread(target=self._outbox.join, daemon=True)
         t.start()
         t.join(timeout)
+        return not t.is_alive()
+
+    def cancel_pending(self) -> None:
+        """Drop whatever is still queued, refunding its score mass
+        (call when giving up on delivery, e.g. after a failed flush —
+        the mass must land SOMEWHERE before scores are compared)."""
+        while True:
+            try:
+                _, (old_score, _leaves) = self._outbox.get_nowait()
+                self._outbox.task_done()
+                self.dropped += 1
+                self._refunds.put(old_score)
+            except queue.Empty:
+                return
 
     def close(self) -> None:
         self._stopped.set()
-        self._outbox.put(None)
+        # clear pending work so the sentinel never blocks on a full
+        # queue of dead-peer payloads
+        self.cancel_pending()
+        try:
+            self._outbox.put_nowait(None)
+        except queue.Full:  # pragma: no cover - sender mid-item
+            pass
         try:
             self._sock.close()
         except OSError:

@@ -411,6 +411,9 @@ def _run_distributed(
 
     def drain_inbox(score):
         nonlocal n_merges
+        # reclaim score mass from pushes the wire gave up on (dropped
+        # oldest under backpressure / dead peer) — conservation first
+        score += peer.take_refunds()
         for s_in, leaves in peer.poll():
             theirs = jax.tree.unflatten(
                 jax.tree.structure(
@@ -475,7 +478,6 @@ def _run_distributed(
     # (flush() only guarantees the bytes LEFT the sender).  The KV
     # waits scale with the run: the no-barrier design means worker
     # skew grows with training length (TM_GOSGD_QUIESCE_S overrides).
-    peer.flush()
     import json as _json
     import time as _time
 
@@ -484,6 +486,14 @@ def _run_distributed(
         "TM_GOSGD_QUIESCE_S", max(600.0, 2.0 * wall)
     ))
     kv_ms = int(quiesce_s * 1000)
+    if not peer.flush(timeout=quiesce_s):
+        # the wire gave up: reclaim the queued payloads' score mass
+        # BEFORE publishing, so sent_counts is the exact total and the
+        # mass is in our posted score rather than lost
+        peer.cancel_pending()
+        if verbose:
+            print("GoSGD quiesce: flush timed out; pending pushes "
+                  "cancelled and refunded", flush=True)
     delivered = {
         r: peer.sent_counts.get(addr, 0) for r, addr in peers.items()
     }
@@ -498,7 +508,7 @@ def _run_distributed(
         )
         expected += int(counts.get(str(pid), 0))
     deadline = _time.monotonic() + quiesce_s
-    score = drain_inbox(score)
+    score = drain_inbox(score)  # also reclaims refunded mass
     while n_merges < expected and _time.monotonic() < deadline:
         _time.sleep(0.05)
         score = drain_inbox(score)
