@@ -52,6 +52,43 @@ def test_conv_stride_pad_shapes():
     assert y.shape == (4, 16, 16, 16)
 
 
+def test_conv_s2d_exact_and_grads(rng):
+    """The space-to-depth stem transform is the SAME convolution:
+    outputs and gradients match the plain strided conv to fp32
+    rounding, for the ResNet stem geometry and others."""
+    for h, k, b, p0 in [(16, 7, 2, 3), (16, 5, 2, 2), (32, 4, 4, 0)]:
+        x = jnp.asarray(rng.normal(size=(2, h, h, 3)), jnp.float32)
+        plain = Conv(8, k, stride=b, pad=p0, bias=False)
+        fast = Conv(8, k, stride=b, pad=p0, bias=False, s2d=True)
+        params, state, out_shape = plain.init(KEY, (h, h, 3))
+        y0, _ = plain.apply(params, state, x)
+        y1, _ = fast.apply(params, state, x)
+        assert y1.shape == y0.shape == (2, *out_shape)
+        np.testing.assert_allclose(
+            np.asarray(y1), np.asarray(y0), rtol=1e-4, atol=1e-5
+        )
+        g0 = jax.grad(
+            lambda p: (plain.apply(p, {}, x)[0] ** 2).sum()
+        )(params)
+        g1 = jax.grad(
+            lambda p: (fast.apply(p, {}, x)[0] ** 2).sum()
+        )(params)
+        np.testing.assert_allclose(
+            np.asarray(g1["w"]), np.asarray(g0["w"]), rtol=1e-4, atol=1e-4
+        )
+
+
+def test_conv_s2d_rejects_bad_geometry():
+    with pytest.raises(ValueError, match="s2d"):
+        Conv(8, 7, stride=2, pad="SAME", s2d=True)
+    # inapplicable spatial geometry silently falls back to the plain
+    # conv (AlexNet-style stems where out != H/b)
+    layer = Conv(8, 11, stride=4, pad=2, bias=False, s2d=True)
+    params, _, out_shape = layer.init(KEY, (64, 64, 3))
+    y, _ = layer.apply(params, {}, jnp.zeros((1, 64, 64, 3)))
+    assert y.shape == (1, *out_shape)
+
+
 def test_pool_max_avg_match_torch(rng):
     x = rng.normal(size=(2, 8, 8, 3)).astype(np.float32)
     tx = torch.tensor(x.transpose(0, 3, 1, 2))

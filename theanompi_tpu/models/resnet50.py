@@ -100,9 +100,14 @@ class ResNet50(ClassifierModel):
         super().__init__(config)
 
     def build_model(self, n_replicas: int = 1) -> None:
+        # stem rides the space-to-depth transform by default: the
+        # 7x7/s2 C=3 conv starves the MXU (~14% of the step on 2.4% of
+        # the FLOPs, measured fwd+bwd on v5e); the transform is exact
+        # and checkpoint-compatible (ops/layers.py Conv s2d)
         layers: list[Layer] = [
             Conv(64, 7, stride=2, pad=3, bias=False,
-                 w_init=initializers.he()),
+                 w_init=initializers.he(),
+                 s2d=bool(self.config.get("stem_s2d", True))),
             BN(),
             Activation("relu"),
             Pool(3, 2, pad="SAME"),
