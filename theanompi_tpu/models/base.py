@@ -45,6 +45,7 @@ from theanompi_tpu.utils import (
     save_checkpoint,
     save_sharded_checkpoint,
 )
+from theanompi_tpu.utils.xla_options import xla_compiler_options
 
 PyTree = Any
 
@@ -298,6 +299,8 @@ class ClassifierModel(TMModel):
 
         rep = P()
         dp = P(DATA_AXIS)
+        # TPU compiler knobs (remote-compile safe; utils/xla_options)
+        self._compiler_options = xla_compiler_options(self.config)
         self._train_step = jax.jit(
             jax.shard_map(
                 shard_train,
@@ -307,6 +310,7 @@ class ClassifierModel(TMModel):
                 check_vma=False,
             ),
             donate_argnums=(0, 1, 2),
+            compiler_options=self._compiler_options,
         )
 
         self._shard_train_body = shard_train
@@ -422,6 +426,7 @@ class ClassifierModel(TMModel):
                 check_vma=False,
             ),
             donate_argnums=(0, 1, 2, 3),
+            compiler_options=self._compiler_options,
         )
 
         # multi-step scan: K steps per dispatch (``steps_per_call``
@@ -458,6 +463,7 @@ class ClassifierModel(TMModel):
                     check_vma=False,
                 ),
                 donate_argnums=(0, 1, 2, 3),
+                compiler_options=self._compiler_options,
             )
             self._scan_k = k
         self._step_dev = jax.device_put(jnp.zeros((), jnp.int32), rep)

@@ -105,16 +105,22 @@ class Cifar10Data:
             self._perm = rng.permutation(len(self._train_y))
         self._epoch = epoch
 
-    def _augment(self, x: np.ndarray, seed: int) -> np.ndarray:
-        rng = np.random.default_rng(seed)
+    def _augment(self, x: np.ndarray, epoch: int, seq: int) -> np.ndarray:
+        """Pad-4-reflect, random 32x32 crop + horizontal flip, with
+        draws from ``aug_rng.crop_flip_draws`` so they are a pure
+        function of (seed, epoch, seq, image) — identical no matter
+        which producer serves the batch (ADVICE r2: this path kept a
+        per-call np RNG after imagenet.py moved to aug_rng)."""
+        from theanompi_tpu.models.data.aug_rng import crop_flip_draws
+
         n, h, w, _ = x.shape
         padded = np.pad(x, ((0, 0), (4, 4), (4, 4), (0, 0)), mode="reflect")
+        ii, jj, flip = crop_flip_draws(
+            self._seed, epoch, seq, n, h + 8, w + 8, h
+        )
         out = np.empty_like(x)
-        ij = rng.integers(0, 9, size=(n, 2))
-        flip = rng.random(n) < 0.5
         for k in range(n):
-            i, j = ij[k]
-            img = padded[k, i : i + h, j : j + w]
+            img = padded[k, ii[k] : ii[k] + h, jj[k] : jj[k] + w]
             out[k] = img[:, ::-1] if flip[k] else img
         return out
 
@@ -124,7 +130,7 @@ class Cifar10Data:
         sel = self._perm[i * self.global_batch : (i + 1) * self.global_batch]
         x, y = self._train_x[sel], self._train_y[sel]
         if self.augment:
-            x = self._augment(x, self._seed * 7 + getattr(self, "_epoch", 0) * 1999 + i)
+            x = self._augment(x, getattr(self, "_epoch", 0), i)
         return x, y
 
     def val_batch(self, i: int):

@@ -464,6 +464,10 @@ class Llama(TMModel):
             logits = self._forward(params, x)
             return self._metrics(logits, y, top5=True)
 
+        # TPU compiler knobs (remote-compile safe; utils/xla_options)
+        from theanompi_tpu.utils.xla_options import xla_compiler_options
+
+        self._compiler_options = xla_compiler_options(self.config)
         self._train_step = jax.jit(
             jax.shard_map(
                 step,
@@ -472,6 +476,7 @@ class Llama(TMModel):
                 out_specs=(specs, opt_specs, P(), P()),
             ),
             donate_argnums=(0, 1),
+            compiler_options=self._compiler_options,
         )
 
         # device-resident multi-step path (same design as
@@ -488,7 +493,8 @@ class Llama(TMModel):
                 mesh=mesh,
                 in_specs=(specs, batch_spec, batch_spec),
                 out_specs=(P(), P(), P()),
-            )
+            ),
+            compiler_options=self._compiler_options,
         )
 
         if self.params is None:
@@ -508,7 +514,8 @@ class Llama(TMModel):
                 return params, self.optimizer.init(params)
 
             self.params, self.opt_state = jax.jit(
-                init, out_shardings=(shardings, opt_shardings)
+                init, out_shardings=(shardings, opt_shardings),
+                compiler_options=self._compiler_options,
             )(jax.random.PRNGKey(self.seed))
         self._batch_sharding = NamedSharding(mesh, batch_spec)
 
@@ -577,6 +584,7 @@ class Llama(TMModel):
                     out_specs=(specs, opt_specs, P(), P(), P()),
                 ),
                 donate_argnums=(0, 1, 2),
+                compiler_options=self._compiler_options,
             )
 
         self._train_scan = make_scan(k)

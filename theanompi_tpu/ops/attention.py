@@ -499,13 +499,29 @@ def flash_attention_tpu(
         )
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
-    # default blocks: largest that tile this T (fall back to the old
-    # 256 default for lengths no power-of-two divides — _flash_dims
-    # then raises its clear divisibility error)
+    # default blocks: largest that tile this T.  A length no aligned
+    # block divides is rejected HERE with an actionable error — the
+    # old `or 256` default let `min(block, t)` clamp back to the
+    # ragged t (e.g. a T_loc=68 ring shard) and fail deep in Mosaic
+    # lowering instead (ADVICE r2).
     if block_q is None:
-        block_q = _auto_block(q.shape[2]) or 256
+        block_q = _auto_block(q.shape[2])
     if block_k is None:
-        block_k = _auto_block(k.shape[2]) or 256
+        block_k = _auto_block(k.shape[2])
+    if not block_q or not block_k:
+        if interpret:
+            # the interpreter has no Mosaic alignment constraint;
+            # ragged blocks stay valid for off-TPU testing
+            block_q = block_q or min(256, q.shape[2])
+            block_k = block_k or min(256, k.shape[2])
+        else:
+            raise ValueError(
+                f"flash kernel needs aligned sequence blocks; "
+                f"T_q={q.shape[2]}, T_k={k.shape[2]} have none (pad "
+                f"the sequence to a multiple of 16 — of 256 beyond "
+                f"1024 — or use mha_reference / flash_attention() "
+                f"which falls back to dense)"
+            )
     return _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret)
 
 
@@ -513,9 +529,15 @@ def _auto_block(t: int) -> int | None:
     """Largest kernel block for a T: the full axis when it fits in one
     block, else the biggest power-of-two divisor — measured on v5e
     (8L/1024d, T2048): 1024-blocks run the train step 1.5x faster
-    than 256-blocks (110 vs 169 ms/step); 2048-blocks exceed VMEM."""
+    than 256-blocks (110 vs 169 ms/step); 2048-blocks exceed VMEM.
+
+    Only sublane-aligned blocks qualify (multiple of 16 — the bf16
+    sublane tile): the block is a Mosaic tile dimension, and a ragged
+    size (e.g. a T_loc=68 ring shard) can fail lowering instead of
+    falling back — callers treat ``None`` as "use the dense path"
+    (ADVICE r2)."""
     if t <= 1024:
-        return t
+        return t if t % 16 == 0 else None
     for s in (1024, 512, 256):
         if t % s == 0:
             return s

@@ -1,0 +1,51 @@
+"""Per-executable XLA compiler options (the TPU flag surface).
+
+On this stack the TPU compiler can run REMOTELY (PJRT remote-compile),
+so ``XLA_FLAGS`` set in the training process never reaches it — the
+local CPU client even aborts on unknown ``--xla_tpu_*`` flags.  The
+supported channel is per-jit ``compiler_options``, which serialize
+into the compile request.  One helper so every compile site (models,
+bench, workers) honors the same knobs:
+
+- ``config["xla_options"]`` — dict of option name → value, or a
+  ``"k=v,k2=v2"`` string
+- ``TM_XLA_OPTIONS`` env — same string form, applied when the config
+  doesn't override it (sweep/CI convenience)
+
+Example: ``TM_XLA_OPTIONS=xla_tpu_scoped_vmem_limit_kib=65536``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+
+def _parse(spec: str) -> dict[str, str]:
+    out: dict[str, str] = {}
+    for item in spec.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        if "=" not in item:
+            raise ValueError(
+                f"TM_XLA_OPTIONS entry {item!r} is not k=v"
+            )
+        k, v = item.split("=", 1)
+        out[k.strip().lstrip("-")] = v.strip()
+    return out
+
+
+def xla_compiler_options(
+    config: dict | None = None,
+) -> Optional[dict[str, Any]]:
+    """Resolve compiler options from config/env; None when unset (so
+    jit calls stay identical to the no-knob path and compile-cache
+    keys don't churn)."""
+    cfg = (config or {}).get("xla_options")
+    if isinstance(cfg, str):
+        return _parse(cfg) or None
+    if isinstance(cfg, dict) and cfg:
+        return {str(k).lstrip("-"): v for k, v in cfg.items()}
+    env = os.environ.get("TM_XLA_OPTIONS", "")
+    return _parse(env) or None if env else None

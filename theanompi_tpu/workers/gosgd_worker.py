@@ -230,6 +230,7 @@ def run(
                     )
                     engine.params = merged["params"]
                     engine.opt_state = merged["opt"]
+                    _ = float(scores[0])  # value-read fence
                 else:
                     # stale delivery: score halves now, payload rides
                     # in flight for `delay` rounds
@@ -241,20 +242,26 @@ def run(
                     # deep-copy the snapshot: the next train step
                     # DONATES engine.params/opt_state, which would
                     # invalidate a bare reference held in the queue.
-                    # Quiesce first: dispatching the copy program while
-                    # the train step's collectives are still running
-                    # can starve XLA:CPU's rendezvous on low-core hosts
-                    # (observed: 4/8 threads arrive, 40s termination
-                    # timeout, hard abort).  Value-read of the step's
-                    # loss output — not block_until_ready, which the
-                    # axon PJRT backend returns from early (see
-                    # models/base.py measurement note).
+                    # Quiesce first: dispatching the copy programs
+                    # while the train step's or ``send``'s collectives
+                    # are still running can starve XLA:CPU's rendezvous
+                    # on low-core hosts (observed: 4/8 threads arrive,
+                    # 40s termination timeout, hard abort).  Value-read
+                    # of BOTH pending outputs — not block_until_ready,
+                    # which the axon PJRT backend returns from early
+                    # (see models/base.py measurement note).
                     _ = float(loss)
-                    in_flight.append((routing, jax.tree.map(
+                    _ = float(scores[0])
+                    snap = jax.tree.map(
                         jnp.copy,
                         {"params": engine.params, "opt": engine.opt_state},
-                    )))
-                _ = float(scores[0])  # value-read fence
+                    )
+                    # fence EVERY copy program (one per leaf): the next
+                    # loop iteration's train step is another
+                    # multi-device program
+                    for leaf in jax.tree.leaves(snap):
+                        _ = float(leaf.ravel()[0])
+                    in_flight.append((routing, snap))
                 recorder.end("comm")
                 n_rounds += 1
             if delay and len(in_flight) > delay:
