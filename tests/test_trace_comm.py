@@ -1,11 +1,16 @@
 """Profiler-trace comm attribution (SURVEY §5.1, VERDICT r1 item 6).
 
-The real signal only exists on a TPU device plane (XLA:CPU traces
-carry host threads, no per-core op timeline), so the parser is tested
-against a synthetic XSpace proto with known op intervals — including
-a collective fully hidden under compute and one partially exposed —
-and the classification/overlap math is checked exactly.  The on-chip
-integration (bench prints exposed comm%) runs in the bench itself.
+Two tiers (VERDICT r2 item 4):
+
+- synthetic XSpace protos with known op intervals — a collective
+  fully hidden under compute, one partially exposed — checking the
+  classification/overlap math exactly (the TPU device-plane layout);
+- a REAL capture: a shard_map'd all-reduce program executed on the
+  multi-device CPU mesh, traced with jax.profiler, parsed through the
+  same ``comm_report`` — proving the attribution classifies real
+  collective timelines, not just fabricated ones.  On XLA:CPU the
+  signal lives on per-device executor threads (thunk events named by
+  HLO instruction + Rendezvous/Wait coordination stalls).
 """
 
 import pytest
@@ -110,3 +115,52 @@ class TestOverlapMath:
     def test_no_trace_raises(self, tmp_path):
         with pytest.raises(FileNotFoundError):
             comm_report(str(tmp_path))
+
+
+class TestRealCollectives:
+    """A non-synthetic timeline: real all-reduces, really traced."""
+
+    def test_cpu_mesh_allreduce_attribution(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from theanompi_tpu.utils.trace_comm import capture_trace
+
+        devs = jax.devices("cpu")
+        if len(devs) < 2:
+            pytest.skip("needs a multi-device CPU mesh")
+        mesh = Mesh(np.array(devs), ("data",))
+
+        def step(x):
+            # compute (matmul) + THE exchange (psum), the BSP shape
+            y = x @ x.T
+            return jax.lax.psum(y, "data")
+
+        fn = jax.jit(jax.shard_map(
+            step, mesh=mesh, in_specs=P("data"), out_specs=P()
+        ))
+        x = jnp.ones((8 * len(devs), 128), jnp.float32)
+        float(fn(x)[0, 0])  # compile + settle outside the capture
+
+        def run():
+            out = None
+            for _ in range(3):
+                out = fn(x)
+            float(out[0, 0])  # value-read fence INSIDE the capture
+
+        capture_trace(run, str(tmp_path))
+        rep = comm_report(str(tmp_path))
+
+        assert rep["n_cores"] >= len(devs), rep
+        assert rep["device_busy_s"] > 0.0
+        # the all-reduce must be visible as collective time...
+        assert rep["collective_s"] > 0.0, rep
+        # ...with a sane exposed/hidden split
+        assert 0.0 <= rep["exposed_comm_s"] <= rep["collective_s"] + 1e-12
+        assert rep["hidden_comm_s"] == pytest.approx(
+            rep["collective_s"] - rep["exposed_comm_s"]
+        )
+        assert 0.0 < rep["comm_frac"] <= 1.0
+        assert rep["top_collectives"], rep

@@ -510,10 +510,11 @@ def flash_attention_tpu(
         block_k = _auto_block(k.shape[2])
     if not block_q or not block_k:
         if interpret:
-            # the interpreter has no Mosaic alignment constraint;
-            # ragged blocks stay valid for off-TPU testing
-            block_q = block_q or min(256, q.shape[2])
-            block_k = block_k or min(256, k.shape[2])
+            # the interpreter has no Mosaic alignment constraint; the
+            # full axis is always a valid (single) block, keeping
+            # ragged lengths runnable for off-TPU testing
+            block_q = block_q or q.shape[2]
+            block_k = block_k or k.shape[2]
         else:
             raise ValueError(
                 f"flash kernel needs aligned sequence blocks; "

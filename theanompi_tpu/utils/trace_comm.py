@@ -36,6 +36,34 @@ COLLECTIVE_MARKERS = (
     "ragged-all-to-all",
     "send",
     "recv",
+    # jax-derived HLO instruction names: manual-mode (shard_map)
+    # collectives keep the primitive's name, e.g. "psum_invariant.7"
+    # on the XLA:CPU thunk timeline (verified on this image)
+    "psum",
+    "pmean",
+    "ppermute",
+    "all_to_all",
+    "all_gather",
+    "reduce_scatter",
+)
+
+# XLA:CPU collective *coordination* events: the executing thread is
+# stalled waiting for the other devices' threads — exposed comm time
+# by definition (there is no separate device timeline on CPU).
+CPU_WAIT_MARKERS = (
+    "rendezvous",
+    "wait: pending_threads",
+    "wait for rendezvous",
+)
+
+# XLA:CPU executor scaffolding: these events SPAN the real thunk
+# events on the same thread (ThunkExecutor::Execute covers the whole
+# program), so counting them as compute would shadow every collective
+# into "hidden".  They are scheduling wrappers, not op work — skipped.
+CPU_WRAPPER_MARKERS = (
+    "thunkexecutor::",
+    "pjrtcpuexecutable::",
+    "executehelper",
 )
 
 
@@ -59,6 +87,18 @@ def capture_trace(fn: Callable[[], Any], trace_dir: str) -> Any:
         out = fn()
         jax.block_until_ready(out) if out is not None else None
     return out
+
+
+def report_of(fn: Callable[[], Any]) -> dict:
+    """Capture ``fn`` into a temp dir and return its ``comm_report``
+    — the one-shot capture-and-attribute recipe shared by bench.py
+    and the multichip gate (``fn`` must fence its own device work,
+    e.g. by a value read)."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        capture_trace(fn, td)
+        return comm_report(td)
 
 
 def _latest_xplanes(trace_dir: str) -> list[str]:
@@ -128,6 +168,20 @@ def comm_report(trace_dir: str) -> dict:
     """Parse the newest trace run under ``trace_dir`` into an
     overlap-aware comm/compute attribution.
 
+    Two timeline layouts are understood (both verified on this image):
+
+    - **TPU device planes** (``/device:TPU:N``): the sync ``XLA Ops``
+      line is the core's op timeline; the ``Async XLA Ops`` line holds
+      DMA/collective activity that OVERLAPS it.  Only collective
+      events are taken from the async line — counting its prefetch
+      copies as busy time would double-count the core (they run on
+      DMA engines while the core computes).
+    - **XLA:CPU host threads** (``/host:CPU`` plane,
+      ``tf_XLAPjRtCpuClient/...`` lines — one per virtual device):
+      thunk-level events carry HLO instruction names; ``Rendezvous`` /
+      ``Wait: pending_threads`` events are cross-device coordination
+      stalls and classify as collective time.
+
     Returns per-core-aggregated::
 
         {"device_busy_s", "collective_s", "exposed_comm_s",
@@ -145,26 +199,51 @@ def comm_report(trace_dir: str) -> dict:
     per_op: dict[str, int] = {}
     per_op_all: dict[str, int] = {}
 
+    def _record(core, op, s, e, *, comm):
+        per_op_all[op] = per_op_all.get(op, 0) + (e - s)
+        if comm:
+            core["comm"].append((s, e))
+            per_op[op] = per_op.get(op, 0) + (e - s)
+        else:
+            core["compute"].append((s, e))
+
     for pi, path in enumerate(_latest_xplanes(trace_dir)):
         space = xplane_pb2.XSpace()
         with open(path, "rb") as f:
             space.ParseFromString(f.read())
         for plane in space.planes:
             name = plane.name
-            if not (name.startswith("/device:")
-                    or "TPU" in name or "XLA" in name):
+            is_host_cpu = name == "/host:CPU"
+            if not (name.startswith("/device:") or "TPU" in name
+                    or "XLA" in name or is_host_cpu):
                 continue
             metadata = plane.event_metadata
+            sync_lines, async_lines = [], []
             for li, line in enumerate(plane.lines):
                 lname = (line.display_name or line.name or "").lower()
-                # the per-core op timeline; skip step/module/framework
-                # annotation lines which nest over the same span
-                if "xla ops" not in lname and lname != "ops":
-                    continue
+                if is_host_cpu:
+                    # XLA:CPU execution lanes: per-device client
+                    # threads (cold/inline thunks) AND the Eigen
+                    # intra-op pool threads, where warm executions
+                    # actually run their thunks (verified: convolution
+                    # / all-reduce / Rendezvous events live on
+                    # tf_XLAEigen lines once the executable is warm)
+                    if lname.startswith(
+                        ("tf_xlapjrtcpuclient", "tf_xlaeigen")
+                    ):
+                        sync_lines.append((li, line, "cpu_thread"))
+                elif "async" in lname and "xla ops" in lname:
+                    async_lines.append((li, line))
+                elif "xla ops" in lname or lname == "ops":
+                    sync_lines.append((li, line, "sync"))
+
+            first_core = None
+            for li, line, mode in sync_lines:
                 # positional key: line ids are not guaranteed distinct
                 core = cores.setdefault(
                     (pi, name, li), {"comm": [], "compute": []}
                 )
+                first_core = first_core or core
                 t0 = line.timestamp_ns
                 for ev in line.events:
                     md = metadata.get(ev.metadata_id)
@@ -173,12 +252,35 @@ def comm_report(trace_dir: str) -> dict:
                     e = s + ev.duration_ps
                     if e <= s:
                         continue
-                    per_op_all[op] = per_op_all.get(op, 0) + (e - s)
-                    if is_collective(op):
-                        core["comm"].append((s, e))
-                        per_op[op] = per_op.get(op, 0) + (e - s)
-                    else:
-                        core["compute"].append((s, e))
+                    oplow = op.lower()
+                    if mode == "cpu_thread" and any(
+                        m in oplow for m in CPU_WRAPPER_MARKERS
+                    ):
+                        continue
+                    comm = is_collective(op) or (
+                        mode == "cpu_thread"
+                        and any(m in oplow for m in CPU_WAIT_MARKERS)
+                    )
+                    _record(core, op, s, e, comm=comm)
+            # async-line events OVERLAP the plane's core (a real TPU
+            # plane is one core: one sync + one async line).  Only
+            # collective activity is taken — counting the async DMA
+            # prefetches as busy time would double-count the core.
+            for li, line in async_lines:
+                if first_core is None:
+                    first_core = cores.setdefault(
+                        (pi, name, f"async{li}"),
+                        {"comm": [], "compute": []},
+                    )
+                t0 = line.timestamp_ns
+                for ev in line.events:
+                    md = metadata.get(ev.metadata_id)
+                    op = md.name if md is not None else ""
+                    s = t0 * 1000 + ev.offset_ps
+                    e = s + ev.duration_ps
+                    if e <= s or not is_collective(op):
+                        continue
+                    _record(first_core, op, s, e, comm=True)
 
     busy_ps = comm_ps = exposed_ps = 0
     for core in cores.values():
