@@ -389,8 +389,8 @@ def main() -> None:
     secondary = {}
     for name in ("wresnet", "llama", "alexnet", "loader"):
         try:
-            secondary[name] = BENCHES[name](with_comm=False) \
-                if name in ("wresnet", "alexnet") else BENCHES[name]()
+            # every entry takes **kw; non-classifiers discard it
+            secondary[name] = BENCHES[name](with_comm=False)
         except Exception as e:  # pragma: no cover - defensive capture
             secondary[name] = {"error": f"{type(e).__name__}: {e}"}
         gc.collect()  # drop the previous model's HBM dataset cache

@@ -74,6 +74,96 @@ class TestServerMath:
             server.stop()
 
 
+class TestWireCompression:
+    """VERDICT r2 item 3: the strategy knob's wire dtype reaches the
+    TCP exchange — bf16 on the wire, fp32 accumulation on both ends,
+    and an ASSERTED ~2x byte reduction on the measured frames."""
+
+    def test_bf16_exchange_math_and_bytes(self):
+        a = 0.25
+        server32 = EASGDCenterServer(tree(0.0), a, host="127.0.0.1")
+        server16 = EASGDCenterServer(tree(0.0), a, host="127.0.0.1")
+        try:
+            c32 = EASGDCenterClient(server32.address)
+            c16 = EASGDCenterClient(server16.address, wire="bfloat16")
+            l32 = c32.exchange(tree(1.0), a)
+            l16 = c16.exchange(tree(1.0), a)
+            # identical elastic math (these values are bf16-exact)
+            np.testing.assert_allclose(l16["w"], l32["w"])
+            np.testing.assert_allclose(
+                server16.center_tree()["w"],
+                server32.center_tree()["w"],
+            )
+            # the center ACCUMULATES fp32 even on the bf16 wire
+            assert server16.center_tree()["w"].dtype == np.float32
+            assert l16["w"].dtype == np.float32
+            # ~2x fewer payload bytes each way
+            assert c16.bytes_sent == c32.bytes_sent // 2, (
+                c16.bytes_sent, c32.bytes_sent
+            )
+            assert c16.bytes_received == c32.bytes_received // 2
+            c32.close()
+            c16.close()
+        finally:
+            server32.stop()
+            server16.stop()
+
+    def test_bf16_wire_rounds_but_tracks(self):
+        """A value bf16 can't represent exactly still lands within
+        bf16 resolution (the wire rounds; the math doesn't drift)."""
+        a = 0.5
+        server = EASGDCenterServer(tree(0.0), a, host="127.0.0.1")
+        try:
+            client = EASGDCenterClient(server.address, wire="bfloat16")
+            val = 1.0039215  # not a bf16 grid point
+            new_local = client.exchange(tree(val), a)
+            np.testing.assert_allclose(
+                new_local["w"], val - a * val, rtol=1e-2
+            )
+            np.testing.assert_allclose(
+                server.center_tree()["w"], a * val, rtol=1e-2
+            )
+            client.close()
+        finally:
+            server.stop()
+
+    def test_gossip_push_bf16_bytes(self):
+        """GossipPeer loopback: a bf16-wire push arrives upcast to
+        fp32 with ~half the bytes of the fp32 push."""
+        import time
+
+        from theanompi_tpu.parallel.gossip_net import GossipPeer
+
+        rng = np.random.default_rng(0)
+        leaves = [rng.standard_normal((64, 8)).astype(np.float32),
+                  rng.standard_normal((32,)).astype(np.float32)]
+        a = GossipPeer(host="127.0.0.1")
+        b = GossipPeer(host="127.0.0.1")
+        try:
+            a.push(b.address, 0.5, leaves)               # fp32 wire
+            a.push(b.address, 0.5, leaves, wire="bfloat16")
+            deadline = time.monotonic() + 30.0
+            got = []
+            while len(got) < 2 and time.monotonic() < deadline:
+                got.extend(b.poll())
+                time.sleep(0.01)
+            assert len(got) == 2, (a.sent, a.dropped, b.received)
+            for score, arrived in got:
+                assert score == 0.5
+                assert arrived[0].dtype == np.float32  # upcast back
+                np.testing.assert_allclose(
+                    arrived[0], leaves[0], rtol=1e-2, atol=1e-2
+                )
+            fp32_bytes = sum(l.nbytes for l in leaves)
+            assert a.bytes_sent == fp32_bytes + fp32_bytes // 2, (
+                a.bytes_sent, fp32_bytes
+            )
+            assert b.bytes_received == a.bytes_sent
+        finally:
+            a.close()
+            b.close()
+
+
 CHILD = textwrap.dedent(
     """
     import os, sys
@@ -88,12 +178,16 @@ CHILD = textwrap.dedent(
     out = easgd_worker.run(
         modelfile="theanompi_tpu.models.wresnet", modelclass="WResNet",
         config={{"batch_size": 2, "n_epochs": 1, "depth": 10, "widen": 1,
-                 "n_train": 16, "n_val": 8}},
+                 "n_train": 16, "n_val": 8,
+                 "exch_strategy": "ici16"}},  # bf16 TCP wire end-to-end
         tau=2, center_addr=f"127.0.0.1:{{cport}}",
         verbose=False,
     )
     print(f"RESULT {{pid}} {{out['exchanges']}} "
           f"{{out['final_train_loss']:.6f}}", flush=True)
+    cv = out.get("center_val")
+    print(f"CENTERVAL {{pid}} "
+          + (f"{{cv['loss']:.6f}}" if cv else "none"), flush=True)
     """
 ).format(repo=str(REPO))
 
@@ -138,13 +232,21 @@ def test_two_process_easgd(tmp_path):
             if p.poll() is None:
                 p.kill()
                 p.wait()
-    results = {}
+    results, center_vals = {}, {}
     for out in outs:
         for line in out.splitlines():
             if line.startswith("RESULT"):
                 _, pid, nex, loss = line.split()
                 results[pid] = (int(nex), float(loss))
+            elif line.startswith("CENTERVAL"):
+                _, pid, cv = line.split()
+                center_vals[pid] = cv
     assert set(results) == {"0", "1"}, outs
+    # the server process validates the CENTER each epoch (SURVEY §3.2)
+    assert center_vals["0"] != "none" and np.isfinite(
+        float(center_vals["0"])
+    ), center_vals
+    assert center_vals["1"] == "none", center_vals
     # both workers exchanged with the center and trained to finite loss
     for pid, (nex, loss) in results.items():
         assert nex >= 2, results

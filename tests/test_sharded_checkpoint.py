@@ -123,6 +123,103 @@ class TestNoHostGather:
         )
 
 
+class Test8BReadiness:
+    @pytest.mark.slow
+    def test_llama3_8b_flow_cross_layout_no_gather(
+        self, devices8, tmp_path, monkeypatch
+    ):
+        """VERDICT r2 item 8 — the LLAMA3_8B flow end-to-end at
+        scaled-down dimensions but REAL sharding: params shard-init
+        under jit with sharded out_shardings, save through the sharded
+        checkpoint, restore under a DIFFERENT mesh layout, and at no
+        point does any host buffer exceed one shard's bytes (a full
+        gather of the 8B tree would OOM a host; the scaled config
+        must prove the code path never takes one).
+        """
+        from theanompi_tpu.models.llama import LLAMA3_8B, Llama
+        from theanompi_tpu.utils import Recorder
+
+        # the 8B structure (GQA, gated MLP, big-vocab shard) with every
+        # dimension divided down; kv heads chosen so BOTH layouts below
+        # divide (tp=2 and tp=4)
+        cfg = dict(
+            LLAMA3_8B,
+            dim=64, n_layers=4, n_heads=8, n_kv_heads=4,
+            ffn_dim=224, vocab=512, seq_len=64,
+            batch_size=2, n_train=16, n_val=8,
+            compute_dtype="float32", n_epochs=1,
+        )
+        mesh_a = make_mesh(data=2, model=2, seq=2, devices=devices8)
+        model = Llama(dict(cfg, tp=2, sp=2))
+        model.build_model(n_replicas=2)
+        model.compile_iter_fns(mesh=mesh_a)
+
+        # sharded init really sharded: at least one leaf partitioned
+        def partitioned(x):
+            return (
+                len(x.sharding.device_set) > 1
+                and not x.sharding.is_fully_replicated
+            )
+
+        part = [l for l in jax.tree.leaves(model.params) if partitioned(l)]
+        assert part, "8B flow must initialize params SHARDED"
+        max_shard_nbytes = max(
+            int(np.prod(l.sharding.shard_shape(l.shape)))
+            * l.dtype.itemsize
+            for l in jax.tree.leaves(model.params)
+        )
+
+        rec = Recorder(verbose=False)
+        model.train_iter(0, rec)
+        model.epoch = 5
+        model.save(str(tmp_path), rec)
+        path = latest_checkpoint(tmp_path)
+        assert is_sharded_checkpoint(path)
+
+        # save side: no written file larger than one shard
+        for idx_file in path.glob("index.p*.json"):
+            for entry in json.loads(idx_file.read_text()).values():
+                for s in entry["shards"]:
+                    assert (path / s["file"]).stat().st_size \
+                        <= max_shard_nbytes + 256  # npy header slack
+
+        # restore under a DIFFERENT layout (tp=4, sp=1), spying every
+        # host materialization
+        seen = []
+        real_empty = np.empty
+
+        def spy_empty(shape, dtype=float, **kw):
+            arr = real_empty(shape, dtype, **kw)
+            seen.append(arr.nbytes)
+            return arr
+
+        monkeypatch.setattr(np, "empty", spy_empty)
+        mesh_b = make_mesh(data=2, model=4, seq=1, devices=devices8)
+        model2 = Llama(dict(cfg, tp=4, sp=1))
+        model2.build_model(n_replicas=2)
+        model2.compile_iter_fns(mesh=mesh_b)
+        rec2 = Recorder(verbose=False)
+        assert model2.load(str(tmp_path), rec2)
+        monkeypatch.setattr(np, "empty", real_empty)
+        assert model2.epoch == 5
+        assert seen and max(seen) <= max_shard_nbytes, (
+            max(seen), max_shard_nbytes
+        )
+
+        # cross-layout restore is exact: compare via host gather of the
+        # TINY test tree (fine at this scale; the guard above is about
+        # the restore path, not the assertion)
+        for a, b in zip(
+            jax.tree.leaves(model.params), jax.tree.leaves(model2.params)
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+        # and the restored model trains
+        model2.train_iter(0, rec2)
+        rec2.flush()
+        assert np.isfinite(rec2.train_losses[-1])
+
+
 class TestLlamaIntegration:
     @pytest.mark.slow
     def test_llama_tp2_sp2_roundtrip(self, devices8, tmp_path):

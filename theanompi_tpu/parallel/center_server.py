@@ -15,9 +15,15 @@ serialises exchanges exactly like the reference's request loop, and
 each worker process exchanges whenever ITS OWN step counter says so —
 no barrier, real out-of-step semantics across processes.
 
-Wire format: length-prefixed pickled (cmd, payload) frames of numpy
-arrays.  Localhost/DCN appropriate; for pod-scale use the per-host
-worker counts stay small (one exchange per tau local steps).
+Wire format: a small length-prefixed pickled control frame, then the
+parameter tree as a STREAMED sequence of per-leaf raw byte chunks —
+never one whole-tree pickle blob (a Llama-scale snapshot would be GBs
+pickled at once; VERDICT r2 item 3).  fp32 leaves optionally travel
+as a narrower wire dtype (bf16 — the reference's ``asa16``/``nccl16``
+fp16-wire analogue, SURVEY §5.8): 2x fewer bytes on every exchange,
+with the elastic update still ACCUMULATED in fp32 server-side.
+Localhost/DCN appropriate; for pod-scale use the per-host worker
+counts stay small (one exchange per tau local steps).
 """
 
 from __future__ import annotations
@@ -26,7 +32,7 @@ import pickle
 import socket
 import struct
 import threading
-from typing import Any
+from typing import Any, Optional
 
 import jax
 import numpy as np
@@ -34,6 +40,7 @@ import numpy as np
 PyTree = Any
 
 _LEN = struct.Struct(">Q")
+_WIRE_CHUNK = 4 << 20  # stream granularity: bounds per-write buffers
 
 
 def _send(sock: socket.socket, obj) -> None:
@@ -55,6 +62,75 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
             raise ConnectionError("peer closed")
         buf.extend(chunk)
     return bytes(buf)
+
+
+# -- streamed array wire ----------------------------------------------------
+
+def _np_dtype(wire) -> Optional[np.dtype]:
+    """Resolve a wire-dtype spec (jnp.bfloat16, 'bfloat16', np dtype,
+    None) to a numpy dtype; bf16 comes from ml_dtypes (jax ships it)."""
+    if wire is None:
+        return None
+    return np.dtype(wire)
+
+
+def wire_cast(leaves: list, wire) -> tuple[list[np.ndarray], list[str]]:
+    """Host-side leaves + their ORIGINAL dtype names, with fp32 leaves
+    cast to the wire dtype (non-fp32 leaves — int steps, bf16
+    leaves — pass through unchanged)."""
+    wdt = _np_dtype(wire)
+    out, orig = [], []
+    for l in leaves:
+        a = np.ascontiguousarray(np.asarray(l))
+        orig.append(a.dtype.name)
+        if wdt is not None and a.dtype == np.float32:
+            a = a.astype(wdt)
+        out.append(a)
+    return out, orig
+
+
+def _stream_body(sock: socket.socket, arrs: list[np.ndarray]) -> int:
+    """Stream each leaf's raw bytes in ``_WIRE_CHUNK`` pieces;
+    returns payload bytes sent.  ZERO-COPY: the leaves are already
+    C-contiguous (wire_cast), so each sends through a uint8 view —
+    ``tobytes()`` would duplicate a Llama-scale leaf on the host,
+    the exact spike the streamed protocol exists to avoid.  (The
+    uint8 reinterpret also sidesteps ml_dtypes bf16's lack of buffer
+    support: ``memoryview(bf16_array)`` raises on dtype 'E'.)"""
+    total = 0
+    for a in arrs:
+        mv = memoryview(a.reshape(-1).view(np.uint8))
+        for off in range(0, len(mv), _WIRE_CHUNK):
+            sock.sendall(mv[off:off + _WIRE_CHUNK])
+        total += len(mv)
+    return total
+
+
+def _send_arrays(sock: socket.socket, arrs: list[np.ndarray],
+                 orig_names: list[str], tag: str = "arrays") -> int:
+    """Stream a leaf list: one small pickled header frame, then the
+    chunked body.  Returns bytes sent (payload only)."""
+    header = [(a.shape, a.dtype.name, o) for a, o in zip(arrs, orig_names)]
+    _send(sock, (tag, header))
+    return _stream_body(sock, arrs)
+
+
+def _recv_arrays_body(sock: socket.socket, header) -> tuple[list, int]:
+    """Receive the leaf bytes described by ``header``, upcasting each
+    leaf back to its ORIGINAL dtype (fp32 accumulation everywhere —
+    the wire dtype never leaks into the math).  Returns (leaves,
+    bytes received)."""
+    leaves, total = [], 0
+    for shape, wire_name, orig_name in header:
+        wdt = np.dtype(wire_name)
+        n = int(np.prod(shape, dtype=np.int64)) * wdt.itemsize
+        buf = _recv_exact(sock, n)
+        a = np.frombuffer(buf, dtype=wdt).reshape(shape)
+        if orig_name != wire_name:
+            a = a.astype(np.dtype(orig_name))
+        leaves.append(a)
+        total += n
+    return leaves, total
 
 
 def _to_host(tree: PyTree) -> list[np.ndarray]:
@@ -146,17 +222,29 @@ class EASGDCenterServer:
                 while True:
                     cmd, payload = _recv(conn)
                     if cmd == "exchange":
+                        # payload: the wire dtype name (or None); the
+                        # worker's leaves follow as a streamed body
+                        tag, header = _recv(conn)
+                        worker_leaves, _ = _recv_arrays_body(conn, header)
                         try:
-                            reply = self._exchange(payload)
+                            pre = self._exchange(worker_leaves)
                         except ValueError as e:
                             # reply instead of dying: a silent thread
                             # death would leave the worker hung in
                             # _recv forever
-                            reply = ("error", str(e))
-                        _send(conn, reply)
+                            _send(conn, ("error", str(e)))
+                            continue
+                        # reply rides the SAME wire dtype (both
+                        # directions halve); worker upcasts to fp32
+                        arrs, orig = wire_cast(pre, payload)
+                        _send(conn, ("ok", None))
+                        _send_arrays(conn, arrs, orig)
                     elif cmd == "get":
                         with self._lock:
-                            _send(conn, [l.copy() for l in self._leaves])
+                            leaves = [l.copy() for l in self._leaves]
+                        arrs, orig = wire_cast(leaves, None)
+                        _send(conn, ("ok", None))
+                        _send_arrays(conn, arrs, orig)
                     elif cmd == "stop":
                         with self._lock:
                             self._stops += 1
@@ -216,10 +304,22 @@ class EASGDCenterServer:
 
 
 class EASGDCenterClient:
-    """Worker-side channel to the center server."""
+    """Worker-side channel to the center server.
 
-    def __init__(self, address: tuple[str, int], connect_timeout: float = 60.0):
+    ``wire`` (e.g. ``"bfloat16"`` / ``jnp.bfloat16``, from the
+    exchange strategy's wire dtype — ``asa16``/``nccl16``/``ici16``)
+    halves every exchange's bytes in BOTH directions; the elastic
+    math stays fp32 on each end.  ``bytes_sent``/``bytes_received``
+    count streamed payload bytes (the compression is assertable)."""
+
+    def __init__(self, address: tuple[str, int], connect_timeout: float = 60.0,
+                 wire=None):
         import time
+
+        self.wire = wire
+        self.wire_name = None if wire is None else _np_dtype(wire).name
+        self.bytes_sent = 0
+        self.bytes_received = 0
 
         # retry with backoff: workers race the server's startup (each
         # process builds+compiles its model first, at its own pace)
@@ -246,17 +346,30 @@ class EASGDCenterClient:
             raise RuntimeError(f"center server: {reply[1]}")
         return reply
 
+    def _recv_tree_body(self) -> list:
+        tag, header = self._check(_recv(self._sock))
+        leaves, n = _recv_arrays_body(self._sock, header)
+        self.bytes_received += n
+        return leaves
+
     def get(self, like: PyTree) -> PyTree:
         _send(self._sock, ("get", None))
-        leaves = self._check(_recv(self._sock))
+        self._check(_recv(self._sock))  # ("ok", None) or error
+        leaves = self._recv_tree_body()
         return jax.tree.unflatten(jax.tree.structure(like), leaves)
 
     def exchange(self, params: PyTree, alpha: float) -> PyTree:
         """Elastic exchange: returns the updated LOCAL params
-        ``w - alpha*(w - c_pre)`` (the server applies its side)."""
+        ``w - alpha*(w - c_pre)`` (the server applies its side).
+        fp32 leaves travel as ``self.wire`` both ways; the local
+        update below runs on the ORIGINAL fp32 values (only the
+        counterpart's view of them is rounded)."""
         leaves = _to_host(params)
-        _send(self._sock, ("exchange", leaves))
-        center_pre = self._check(_recv(self._sock))
+        _send(self._sock, ("exchange", self.wire_name))
+        arrs, orig = wire_cast(leaves, self.wire)
+        self.bytes_sent += _send_arrays(self._sock, arrs, orig)
+        self._check(_recv(self._sock))  # ("ok", None) or error
+        center_pre = self._recv_tree_body()
         new_leaves = [
             w - alpha * (w - np.asarray(c, w.dtype))
             for w, c in zip(leaves, center_pre)

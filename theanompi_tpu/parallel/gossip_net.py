@@ -24,8 +24,11 @@ from typing import Any
 
 from theanompi_tpu.parallel.center_server import (
     _recv,
+    _recv_arrays_body,
     _routable_host,
     _send,
+    _stream_body,
+    wire_cast,
 )
 
 PyTree = Any
@@ -59,6 +62,8 @@ class GossipPeer:
         self.sent = 0
         self.received = 0
         self.dropped = 0
+        self.bytes_sent = 0
+        self.bytes_received = 0
         self.sent_counts: dict[tuple[str, int], int] = {}
         self._listener = threading.Thread(target=self._listen, daemon=True)
         self._listener.start()
@@ -83,8 +88,14 @@ class GossipPeer:
     def _ingest(self, conn: socket.socket) -> None:
         try:
             with conn:
-                payload = _recv(conn)
-                self._inbox.put(payload)
+                # ("push", score, header) control frame, then the
+                # leaves streamed raw (wire dtype per header; upcast
+                # to the original fp32 here — merge math never sees
+                # the rounded representation's dtype)
+                _tag, score, header = _recv(conn)
+                leaves, n = _recv_arrays_body(conn, header)
+                self.bytes_received += n
+                self._inbox.put((score, leaves))
                 self.received += 1
         except (ConnectionError, EOFError, OSError):
             return
@@ -101,20 +112,25 @@ class GossipPeer:
 
     # -- send side --------------------------------------------------------
 
-    def push(self, addr: tuple[str, int], score: float, leaves: list) -> None:
+    def push(self, addr: tuple[str, int], score: float, leaves: list,
+             wire=None) -> None:
         """Queue a push; the sender thread ships it without blocking
-        training (isend semantics).  A full outbox drops the OLDEST
-        queued payload — its score mass goes to the refund queue (the
-        sender halved its score at push time; un-merged mass must
-        return home or the cluster's scores stop summing to 1)."""
-        item = (addr, (float(score), leaves))
+        training (isend semantics).  ``wire`` (e.g. bf16 from the
+        ``*16`` strategies) casts fp32 leaves HERE, at enqueue — the
+        outbox then holds half the bytes too, not just the socket.  A
+        full outbox drops the OLDEST queued payload — its score mass
+        goes to the refund queue (the sender halved its score at push
+        time; un-merged mass must return home or the cluster's scores
+        stop summing to 1)."""
+        arrs, orig = wire_cast(leaves, wire)
+        item = (addr, float(score), arrs, orig)
         while True:
             try:
                 self._outbox.put_nowait(item)
                 return
             except queue.Full:
                 try:
-                    _, (old_score, _leaves) = self._outbox.get_nowait()
+                    _, old_score, _arrs, _o = self._outbox.get_nowait()
                     self._outbox.task_done()
                     self.dropped += 1
                     self._refunds.put(old_score)
@@ -137,15 +153,21 @@ class GossipPeer:
             if item is None:
                 self._outbox.task_done()
                 return
-            addr, payload = item
+            addr, score, arrs, orig = item
             try:
                 with socket.create_connection(addr, timeout=30.0) as s:
-                    _send(s, payload)
+                    _send(s, ("push", score, [
+                        (a.shape, a.dtype.name, o)
+                        for a, o in zip(arrs, orig)
+                    ]))
+                    # stream the body through the shared chunked wire
+                    # (header already sent above, so bypass its frame)
+                    self.bytes_sent += _stream_body(s, arrs)
                 self.sent += 1
                 self.sent_counts[addr] = self.sent_counts.get(addr, 0) + 1
             except OSError:
                 self.dropped += 1  # dead peer: refund, keep training
-                self._refunds.put(payload[0])
+                self._refunds.put(score)
             finally:
                 self._outbox.task_done()
 
@@ -166,7 +188,7 @@ class GossipPeer:
         the mass must land SOMEWHERE before scores are compared)."""
         while True:
             try:
-                _, (old_score, _leaves) = self._outbox.get_nowait()
+                _, old_score, _arrs, _o = self._outbox.get_nowait()
                 self._outbox.task_done()
                 self.dropped += 1
                 self._refunds.put(old_score)

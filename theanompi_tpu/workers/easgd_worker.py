@@ -371,8 +371,15 @@ def _run_distributed(
             client.key_value_set("tm_easgd_center", addr)
         else:
             addr = client.blocking_key_value_get("tm_easgd_center", 60000)
+    # the strategy knob's wire dtype applies to the TCP exchange too
+    # (the reference's asa16/nccl16 fp16 wire, SURVEY §5.8): *16
+    # configs ship bf16 leaves both ways, elastic math stays fp32
+    from theanompi_tpu.parallel import get_strategy
+
+    wire = get_strategy(cfg.get("exch_strategy", "ici32")).wire_dtype
     tcp = EASGDCenterClient(
-        (addr.rsplit(":", 1)[0], int(addr.rsplit(":", 1)[1]))
+        (addr.rsplit(":", 1)[0], int(addr.rsplit(":", 1)[1])),
+        wire=wire,
     )
 
     data = model.data
@@ -385,6 +392,7 @@ def _run_distributed(
 
     step = 0
     n_exchanges = 0
+    center_vals: list[dict] = []
     while model.epoch < model.n_epochs:
         epoch = model.epoch
         recorder.start_epoch()
@@ -412,6 +420,29 @@ def _run_distributed(
                     for j in range(data.n_batch_val)]
             l, e, e5 = (float(sum(v) / len(v)) for v in zip(*vals))
             recorder.val_error(l, e, e5)
+        if data.n_batch_val and server is not None:
+            # the reference's server validates the CENTER (SURVEY
+            # §3.2) — local-val above measures each worker's replica,
+            # this measures the consensus weights users actually ship.
+            # Process 0 holds the center in-process; no TCP round-trip
+            local_params = model.params
+            model.params = jax.device_put(
+                server.center_tree(),
+                jax.tree.map(lambda x: x.sharding, local_params),
+            )
+            cvals = [model.val_iter(j, recorder)
+                     for j in range(data.n_batch_val)]
+            cl, ce, ce5 = (float(sum(v) / len(v)) for v in zip(*cvals))
+            model.params = local_params
+            center_vals.append(
+                {"epoch": epoch, "loss": cl, "err": ce, "err5": ce5}
+            )
+            if verbose:
+                print(
+                    f"EASGD center val: epoch {epoch} "
+                    f"loss {cl:.4f} err {ce:.4f}",
+                    flush=True,
+                )
         recorder.end_epoch(epoch)
         model.adjust_hyperp(epoch + 1)
         if server is not None and checkpoint_dir:
@@ -457,6 +488,10 @@ def _run_distributed(
             recorder.train_losses[-1] if recorder.train_losses else None
         ),
         "final_val": last_val,
+        # per-epoch validation of the CENTER weights (process 0 only;
+        # empty elsewhere) — the server-semantics metric
+        "center_vals": center_vals,
+        "center_val": center_vals[-1] if center_vals else None,
         "epoch_times": recorder.epoch_times,
         "recorder": recorder,
         "model": model,

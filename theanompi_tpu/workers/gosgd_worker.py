@@ -364,6 +364,11 @@ def _run_distributed(
     p_push = float(
         push_prob if push_prob is not None else cfg.get("push_prob", 0.25)
     )
+    # *16 strategies put bf16 on the gossip wire (halves push bytes
+    # AND outbox memory); the score-weighted merge stays fp32
+    from theanompi_tpu.parallel import get_strategy
+
+    wire = get_strategy(cfg.get("exch_strategy", "ici32")).wire_dtype
     recorder = Recorder(
         rank=pid, size=n_procs, print_freq=print_freq, verbose=verbose
     )
@@ -415,6 +420,8 @@ def _run_distributed(
     score = 1.0 / n_procs
     n_pushes = 0
     n_merges = 0
+    mid_saves: list[dict] = []
+    epoch_scores: list[float] = []
     data = model.data
     if verbose and pid == 0:
         print(
@@ -463,7 +470,8 @@ def _run_distributed(
                 recorder.flush()  # fence: snapshot AFTER the step
                 snap = snapshot_host()
                 score *= 0.5
-                peer.push(peers[dst], score, jax.tree.leaves(snap))
+                peer.push(peers[dst], score, jax.tree.leaves(snap),
+                          wire=wire)
                 n_pushes += 1
             recorder.end("comm")
             recorder.print_train_info(i)
@@ -476,12 +484,60 @@ def _run_distributed(
             recorder.val_error(l, e, e5)
         recorder.end_epoch(epoch)
         model.adjust_hyperp(epoch + 1)
-        if checkpoint_dir and pid == 0:
-            # per-epoch crash recovery (single-process path saves the
-            # best replica; mid-run there is no global score view, and
-            # reference semantics say ANY worker's weights are the
-            # model — process 0's replica is the epoch checkpoint)
-            model.save(checkpoint_dir, recorder)
+        epoch_scores.append(float(score))
+        if checkpoint_dir:
+            # mid-run BEST-SCORE checkpoint (VERDICT r2 item 10): each
+            # worker publishes its post-epoch score to the KV store,
+            # then reads the peers' — everyone publishes before
+            # reading, so all complete views agree on the argmax and
+            # exactly the best worker saves.  NOTE: checkpoint_dir
+            # thus implies a per-epoch soft sync bounded by
+            # TM_GOSGD_CKPT_SYNC_S (default 60s) per missing peer;
+            # without checkpointing the training loop stays
+            # barrier-free.  The final checkpoint below still uses
+            # the exact post-drain scores.
+            import json as _json2
+
+            kv.key_value_set(
+                f"tm_gosgd_{tag}_esc_{epoch}_{pid}", f"{score:.9e}"
+            )
+            # compare the PUBLISHED representation on both sides —
+            # comparing a peer's rounded wire value against the local
+            # exact float can make two workers each defer to (or each
+            # outrank) the other when scores differ below the wire
+            # precision, yielding zero or two savers
+            best_pid = pid
+            best_score = float(f"{score:.9e}")
+            complete_view = True
+            sync_ms = int(float(os.environ.get(
+                "TM_GOSGD_CKPT_SYNC_S", "60"
+            )) * 1000)
+            for r in range(n_procs):
+                if r == pid:
+                    continue
+                try:
+                    s = float(kv.blocking_key_value_get(
+                        f"tm_gosgd_{tag}_esc_{epoch}_{r}", sync_ms
+                    ))
+                except Exception:
+                    # a worker with an INCOMPLETE view must not elect
+                    # itself: its argmax can disagree with a complete
+                    # view's, and two model.save() writers would
+                    # interleave shards.  Skipping one epoch's
+                    # mid-run save is benign — the next epoch retries
+                    # and the final checkpoint uses exact scores.
+                    complete_view = False
+                    continue
+                if s > best_score or (s == best_score and r < best_pid):
+                    best_pid, best_score = r, s
+            if complete_view and best_pid == pid:
+                model.save(checkpoint_dir, recorder)
+                with open(os.path.join(
+                    checkpoint_dir, "gosgd_best.json"
+                ), "w") as f:
+                    _json2.dump({"epoch": epoch, "pid": pid,
+                                 "score": score}, f)
+                mid_saves.append({"epoch": epoch, "score": score})
         model.epoch += 1
 
     # quiesce: ship queued pushes, publish per-destination DELIVERED
@@ -556,6 +612,10 @@ def _run_distributed(
         "delivered": sum(delivered.values()),
         "merges": n_merges,
         "score": score,
+        # epochs where THIS process held the best published score and
+        # wrote the mid-run checkpoint (VERDICT r2 item 10)
+        "mid_saves": mid_saves,
+        "epoch_scores": epoch_scores,
         "process_index": pid,
         "final_train_loss": (
             recorder.train_losses[-1] if recorder.train_losses else None
