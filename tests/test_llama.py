@@ -147,6 +147,53 @@ class TestLayoutInvariance:
 
 
 @pytest.mark.slow
+class TestPipelineHeadCost:
+    def test_head_flops_scale_inverse_with_stages(self, devices8):
+        """VERDICT r2 item 6: with the scattered head, each pipeline
+        stage computes the lm head on 1/S of the tokens — XLA's own
+        cost_analysis of the per-device module must show the masked
+        path paying ~one full head more than the scattered path."""
+        vocab, dim, b, t = 2048, 64, 8, 64
+        over = dict(
+            vocab=vocab, dim=dim, seq_len=t, batch_size=b,
+            n_train=b * 8, n_val=b,
+        )
+        flops = {}
+        for scatter in (True, False):
+            m = build(devices8, data=1, pp=2, pp_microbatches=8,
+                      pp_head_scatter=scatter, **over)
+            ca = m.train_step_cost_analysis()
+            flops[scatter] = (
+                sum(float(d.get("flops", 0)) for d in ca)
+                if isinstance(ca, list) else float(ca.get("flops", 0))
+            )
+        assert m._pp_scatter is False  # knob respected on last build
+        # per-device head cost (fwd matmul): 2 * n_tok * D * V; bwd
+        # roughly doubles-to-triples it.  Scatter halves it at S=2, so
+        # the masked module must carry at least ~one fwd-head more.
+        head_fwd = 2.0 * b * t * dim * vocab
+        assert flops[True] < flops[False] - head_fwd, flops
+
+    def test_scattered_head_matches_masked(self, devices8):
+        """Both head placements are the same math: identical first
+        train-step loss (scatter is a cost layout, not a model)."""
+        kw = dict(data=2, tp=1, sp=1, pp=2, batch_size=2,
+                  optimizer="sgd", lr=0.5)
+        ms = build(devices8, pp_head_scatter=True, **kw)
+        mm = build(devices8, pp_head_scatter=False, **kw)
+        assert ms._pp_scatter and not mm._pp_scatter
+        rs, rm = Recorder(rank=0), Recorder(rank=0)
+        for i in range(3):
+            ms.train_iter(i, rs)
+            mm.train_iter(i, rm)
+        rs.flush()
+        rm.flush()
+        np.testing.assert_allclose(
+            rs.train_losses, rm.train_losses, rtol=1e-4
+        )
+
+
+@pytest.mark.slow
 class TestTraining:
     def test_full_4d_parallel_step(self, devices8):
         """tp x sp x pp all active at once (dp=1 on 8 devices): the
