@@ -283,11 +283,14 @@ def bench_classifier(which: str, with_comm: bool = True) -> dict:
     else:
         _, modelclass, cls, cfg, batch = load_flagship()
         img_bytes = 224 * 224 * 3 * 2         # ImageNet-shape bf16
-    # 20 batches per epoch (chunked dispatch below always runs whole
-    # scans, never a ragged tail) — but cap the HBM dataset cache: it
-    # is REPLICATED per device, so letting it scale with chip count
+    # 80 batches per epoch (chunked dispatch below always runs whole
+    # scans, never a ragged tail): host dispatch through a tunneled
+    # runtime is still ~1ms/scan, so longer scans keep paying — 20 ->
+    # 80 steps/dispatch measured +3.5% on the flagship (160 compiles
+    # too slowly to amortize).  Cap the HBM dataset cache: it is
+    # REPLICATED per device, so letting it scale with chip count
     # would OOM large slices; fewer batches just means epochs recycle
-    nb_cap = max(2, min(20, (2 << 30) // (batch * n_chips * img_bytes)))
+    nb_cap = max(2, min(80, (4 << 30) // (batch * n_chips * img_bytes)))
     cfg["n_train"] = nb_cap * batch * n_chips
     cfg["n_val"] = batch * n_chips
     # HBM-resident dataset: one staging transfer, per-step traffic is
@@ -388,11 +391,19 @@ def main() -> None:
     rec = BENCHES["resnet50"]()
     secondary = {}
     for name in ("wresnet", "llama", "alexnet", "loader"):
-        try:
-            # every entry takes **kw; non-classifiers discard it
-            secondary[name] = BENCHES[name](with_comm=False)
-        except Exception as e:  # pragma: no cover - defensive capture
-            secondary[name] = {"error": f"{type(e).__name__}: {e}"}
+        # two attempts: the tunneled remote-compile service drops a
+        # response now and then (observed: "response body closed
+        # before all bytes were read"); a transient must not cost the
+        # driver capture a whole flagship metric
+        for attempt in (1, 2):
+            try:
+                # every entry takes **kw; non-classifiers discard it
+                secondary[name] = BENCHES[name](with_comm=False)
+                break
+            except Exception as e:  # pragma: no cover - transient env
+                secondary[name] = {"error": f"{type(e).__name__}: {e}"}
+                gc.collect()  # free the failed attempt's HBM cache
+                              # BEFORE retrying, not just between benches
         gc.collect()  # drop the previous model's HBM dataset cache
     rec["secondary"] = secondary
     print(json.dumps(rec))

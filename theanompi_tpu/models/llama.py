@@ -299,9 +299,13 @@ class Llama(TMModel):
     def _forward(self, params, ids):
         """ids [B_loc, T_loc] -> local vocab-shard logits [.., V/tp].
 
-        With ``pp > 1`` logits are VALID ON THE LAST PIPELINE STAGE
-        ONLY (other stages hold zeros-driven garbage); every metric
-        derived from them must go through ``_pp_value``."""
+        With ``pp > 1`` and the default scattered head, logits are a
+        VALID 1/S TOKEN SLICE on every stage ([n_tok/S, V/tp]) —
+        metrics must slice targets with ``_pp_targets`` (same
+        geometry) and recombine through ``_pp_value`` (pipe-pmean).
+        On the ragged fallback (``_pp_scatter`` False) logits are
+        instead valid on the LAST stage only (other stages hold
+        zeros-driven garbage) and ``_pp_value`` masks to it."""
         cdtype = self.compute_dtype
         t_loc = ids.shape[1]
         seq_idx = lax.axis_index(SEQ_AXIS)
@@ -602,11 +606,8 @@ class Llama(TMModel):
         gb = int(self.data.global_batch)
         b_loc = int(self.config.get("batch_size", 8))
         t_loc = self.seq_len // self.sp
-        assert self.mesh.shape[DATA_AXIS] * b_loc == gb, (
-            f"device cache: mesh data axis {self.mesh.shape[DATA_AXIS]} "
-            f"x per-replica batch {b_loc} != global batch {gb} "
-            f"(build_model n_replicas must match the mesh)"
-        )
+        # (mesh data axis x b_loc == gb already asserted by
+        # compile_iter_fns before this runs)
         specs, opt_specs = self._specs, self._opt_specs
         rep = NamedSharding(self.mesh, P())
 
