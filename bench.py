@@ -143,11 +143,12 @@ def bench_llama() -> dict:
     cfg = dict(
         dim=1024, n_layers=8, n_heads=16, n_kv_heads=8, ffn_dim=2816,
         vocab=32000, seq_len=2048, batch_size=4, remat=True,
-        # 20 batches/epoch = 2 whole scans: the chunked loop must never
-        # fall into the (uncompiled) per-step tail inside the timed run
+        # 20 batches/epoch = ONE whole scan per epoch: the chunked
+        # loop must never fall into the (uncompiled) per-step tail
+        # inside the timed run
         n_train=20 * 4 * n_chips, n_val=8,
         exch_strategy="ici16",
-        device_data_cache=True, steps_per_call=10,
+        device_data_cache=True, steps_per_call=20,
     )
     model = Llama(cfg)
     model.build_model(n_replicas=n_chips)
@@ -251,15 +252,17 @@ def bench_loader() -> dict:
     }
 
 
-def bench_classifier(which: str, with_comm: bool = True) -> dict:
-    """Image-classifier training images/sec/chip on the contract path.
+def build_classifier(which: str, batch: int | None = None,
+                     nb: int | None = None):
+    """Build + compile a classifier flagship on the CONTRACT path
+    (device_data_cache + whole-scan dispatch) — shared by the bench
+    and scripts/profile_flagship.py so the profiler measures exactly
+    the configuration the bench reports.
 
-    ``which``: 'resnet50' (the flagship / headline), 'wresnet'
-    (secondary classifier, CIFAR shapes), or 'alexnet' (the reference
-    paper's primary benchmark model)."""
+    Returns ``(model, modelclass, batch, nb)``."""
     from theanompi_tpu.models import load_flagship
     from theanompi_tpu.parallel import default_devices, make_mesh
-    from theanompi_tpu.utils import Recorder, enable_compile_cache
+    from theanompi_tpu.utils import enable_compile_cache
 
     enable_compile_cache()
     devices = default_devices()
@@ -269,7 +272,7 @@ def bench_classifier(which: str, with_comm: bool = True) -> dict:
     if which == "wresnet":
         from theanompi_tpu.models.wresnet import WResNet
 
-        modelclass, cls, batch = "WResNet", WResNet, 256
+        modelclass, cls, batch = "WResNet", WResNet, batch or 256
         cfg = {"batch_size": batch, "depth": 28, "widen": 10}
         img_bytes = 32 * 32 * 3 * 2           # CIFAR bf16
     elif which == "alexnet":
@@ -277,11 +280,13 @@ def bench_classifier(which: str, with_comm: bool = True) -> dict:
         # (BASELINE.md config[0]; arXiv:1605.08325 experiments)
         from theanompi_tpu.models.alex_net import AlexNet
 
-        modelclass, cls, batch = "AlexNet", AlexNet, 128
+        modelclass, cls, batch = "AlexNet", AlexNet, batch or 128
         cfg = {"batch_size": batch}
         img_bytes = 224 * 224 * 3 * 2
     else:
-        _, modelclass, cls, cfg, batch = load_flagship()
+        _, modelclass, cls, cfg, def_batch = load_flagship()
+        batch = batch or def_batch
+        cfg["batch_size"] = batch
         img_bytes = 224 * 224 * 3 * 2         # ImageNet-shape bf16
     # 80 batches per epoch (chunked dispatch below always runs whole
     # scans, never a ragged tail): host dispatch through a tunneled
@@ -290,19 +295,35 @@ def bench_classifier(which: str, with_comm: bool = True) -> dict:
     # too slowly to amortize).  Cap the HBM dataset cache: it is
     # REPLICATED per device, so letting it scale with chip count
     # would OOM large slices; fewer batches just means epochs recycle
-    nb_cap = max(2, min(80, (4 << 30) // (batch * n_chips * img_bytes)))
-    cfg["n_train"] = nb_cap * batch * n_chips
+    if nb is None:
+        nb = max(2, min(80, (4 << 30) // (batch * n_chips * img_bytes)))
+    cfg["n_train"] = nb * batch * n_chips
     cfg["n_val"] = batch * n_chips
     # HBM-resident dataset: one staging transfer, per-step traffic is
     # the index vector only (essential on thin host↔device links);
     # K steps ride each dispatch (scan) to amortize host latency —
-    # K follows the epoch size so large slices (small nb_cap) still
+    # K follows the epoch size so large slices (small nb) still
     # run whole scans instead of degrading to per-step dispatch
     cfg["device_data_cache"] = True
-    cfg.setdefault("steps_per_call", nb_cap)
+    cfg.setdefault("steps_per_call", nb)
     model = cls(cfg)
     model.build_model(n_replicas=n_chips)
     model.compile_iter_fns(mesh=mesh, exch_strategy="ici32")
+    return model, modelclass, batch, nb
+
+
+def bench_classifier(which: str, with_comm: bool = True) -> dict:
+    """Image-classifier training images/sec/chip on the contract path.
+
+    ``which``: 'resnet50' (the flagship / headline), 'wresnet'
+    (secondary classifier, CIFAR shapes), or 'alexnet' (the reference
+    paper's primary benchmark model)."""
+    from theanompi_tpu.parallel import default_devices
+    from theanompi_tpu.utils import Recorder
+
+    model, modelclass, batch, _ = build_classifier(which)
+    devices = default_devices()
+    n_chips = len(devices)
 
     # contract path: the SAME chunked loop bsp_worker runs — train_chunk
     # dispatches the K-step scan, loss reads deferred to Recorder.flush
@@ -359,6 +380,16 @@ def bench_classifier(which: str, with_comm: bool = True) -> dict:
     }
 
 
+def _transient(e: Exception) -> bool:
+    """Errors worth one retry: the tunneled remote-compile/transport
+    hiccups, not deterministic config/OOM failures."""
+    msg = str(e)
+    return any(t in msg for t in (
+        "remote_compile", "response body", "Connection",
+        "UNAVAILABLE", "DEADLINE", "Socket closed",
+    ))
+
+
 BENCHES = {
     "resnet50": lambda **kw: bench_classifier("resnet50", **kw),
     "wresnet": lambda **kw: bench_classifier("wresnet", **kw),
@@ -371,6 +402,7 @@ BENCHES = {
 def main() -> None:
     import gc
     import os
+    import sys
 
     which = os.environ.get("TM_BENCH_MODEL", "").lower()
     if which:
@@ -404,6 +436,11 @@ def main() -> None:
                 secondary[name] = {"error": f"{type(e).__name__}: {e}"}
                 gc.collect()  # free the failed attempt's HBM cache
                               # BEFORE retrying, not just between benches
+                if not _transient(e):
+                    break  # deterministic failure: a re-run would just
+                           # burn another multi-minute compile
+                print(f"bench {name}: transient failure, retrying "
+                      f"({e})", file=sys.stderr)
         gc.collect()  # drop the previous model's HBM dataset cache
     rec["secondary"] = secondary
     print(json.dumps(rec))

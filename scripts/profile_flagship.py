@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Profile a flagship model's train step on the current backend and
+print the top ops + comm attribution — the tool behind
+docs/PERFORMANCE.md's "Known ceilings" breakdown.
+
+Usage (repo root):
+
+    python scripts/profile_flagship.py [resnet50|wresnet|alexnet] \
+        [--batch 128] [--steps 20]
+
+Runs the SAME contract path as bench.py (device_data_cache +
+steps_per_call scan), captures a jax.profiler trace of one warm scan,
+and aggregates the op timeline: per-op totals (the `while` wrapper of
+the scan excluded) plus the overlap-aware collective split.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("model", nargs="?", default="resnet50",
+                    choices=["resnet50", "wresnet", "alexnet"])
+    ap.add_argument("--batch", type=int, default=None)
+    ap.add_argument("--steps", type=int, default=20,
+                    help="scan length per dispatch (and trace window)")
+    ap.add_argument("--top", type=int, default=25)
+    ns = ap.parse_args()
+
+    from bench import build_classifier
+    from theanompi_tpu.parallel import default_devices
+    from theanompi_tpu.utils import Recorder
+    from theanompi_tpu.utils.trace_comm import report_of
+
+    # the EXACT setup bench.py measures (shared builder), with the
+    # scan length overridden so the trace window stays short
+    model, _, batch, _ = build_classifier(
+        ns.model, batch=ns.batch, nb=ns.steps
+    )
+    n = len(default_devices())
+
+    rec = Recorder(verbose=False)
+    nb = model.data.n_batch_train
+    t0 = time.perf_counter()
+    model.train_chunk(0, model.preferred_chunk(nb), rec)
+    rec.flush()
+    print(f"warmup (compile) {time.perf_counter() - t0:.1f}s")
+    t0 = time.perf_counter()
+    model.train_chunk(0, model.preferred_chunk(nb), rec)
+    rec.flush()
+    dt = time.perf_counter() - t0
+    print(f"rate: {ns.steps * batch * n / dt:.1f} img/s "
+          f"({dt / ns.steps * 1e3:.2f} ms/step)")
+
+    def warm_scan():
+        model.train_chunk(0, model.preferred_chunk(nb), rec)
+        rec.flush()
+
+    rep = report_of(warm_scan, top_n=ns.top + 10)
+    busy = rep["device_busy_s"] or 1.0
+    print(f"device busy {busy:.4f} core-s over {rep['n_cores']} cores; "
+          f"collective {rep['comm_frac']:.1%} "
+          f"(exposed {rep['exposed_comm_frac']:.1%})")
+    # per-op table, the scan's `while` wrapper excluded (top_ops keys
+    # are already unique per op name)
+    ops = [(op, sec) for op, sec in rep["top_ops"]
+           if not op.lstrip("%").startswith("while")]
+    print(f"top {ns.top} ops:")
+    for op, sec in ops[: ns.top]:
+        print(f"  {sec / busy:6.2%} {sec * 1e3:9.2f} ms  {op[:110]}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

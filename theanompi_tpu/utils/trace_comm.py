@@ -89,7 +89,7 @@ def capture_trace(fn: Callable[[], Any], trace_dir: str) -> Any:
     return out
 
 
-def report_of(fn: Callable[[], Any]) -> dict:
+def report_of(fn: Callable[[], Any], top_n: int = 15) -> dict:
     """Capture ``fn`` into a temp dir and return its ``comm_report``
     — the one-shot capture-and-attribute recipe shared by bench.py
     and the multichip gate (``fn`` must fence its own device work,
@@ -98,7 +98,7 @@ def report_of(fn: Callable[[], Any]) -> dict:
 
     with tempfile.TemporaryDirectory() as td:
         capture_trace(fn, td)
-        return comm_report(td)
+        return comm_report(td, top_n=top_n)
 
 
 def _latest_xplanes(trace_dir: str) -> list[str]:
@@ -164,7 +164,7 @@ def _subtract(a: list[tuple[int, int]],
     return out
 
 
-def comm_report(trace_dir: str) -> dict:
+def comm_report(trace_dir: str, top_n: int = 15) -> dict:
     """Parse the newest trace run under ``trace_dir`` into an
     overlap-aware comm/compute attribution.
 
@@ -308,7 +308,9 @@ def comm_report(trace_dir: str) -> dict:
         "top_collectives": [(k, v * ps) for k, v in top],
         "top_ops": [
             (k, v * ps)
-            for k, v in sorted(per_op_all.items(), key=lambda kv: -kv[1])[:15]
+            for k, v in sorted(
+                per_op_all.items(), key=lambda kv: -kv[1]
+            )[:top_n]
         ],
     }
 
