@@ -58,6 +58,11 @@ class TestServerMath:
             np.testing.assert_allclose(l1["w"], 1.0)   # 2 - .5*(2-0)
             np.testing.assert_allclose(l2["w"], 2.5)   # 4 - .5*(4-1)
             np.testing.assert_allclose(server.center_tree()["w"], 2.5)
+            # backpressure metrics served over the wire (r2 weak #6)
+            stats = c1.stats()
+            assert stats["exchanges"] == 2
+            assert stats["mean_hold_s"] >= 0.0
+            assert stats["max_wait_s"] >= stats["mean_wait_s"] >= 0.0
             c1.close()
             c2.close()
         finally:

@@ -191,6 +191,20 @@ def run(
 
     step = 0
     n_exchanges = 0
+
+    def _adopt_center() -> None:
+        """Quiesce, then set the model's state to the center weights +
+        consensus net/opt state.  The fence first: the mean_* calls
+        dispatch per-leaf multi-device programs, and racing them
+        against in-flight train/exchange programs can starve XLA:CPU's
+        rendezvous on low-core hosts (value reads are the only honest
+        fence on this image — see base.py)."""
+        recorder.flush()
+        _ = float(jax.tree.leaves(center)[0].reshape(-1)[0])
+        model.params = center
+        model.net_state = engine.mean_net_state()
+        model.opt_state = engine.mean_opt_state()
+
     while model.epoch < model.n_epochs:
         epoch = model.epoch
         recorder.start_epoch()
@@ -265,15 +279,11 @@ def run(
         if checkpoint_dir:
             # center owns the checkpoint (reference: server saves);
             # consensus momentum rides along so resume keeps velocity
-            model.params = center
-            model.net_state = engine.mean_net_state()
-            model.opt_state = engine.mean_opt_state()
+            _adopt_center()
             model.save(checkpoint_dir, recorder)
         model.epoch += 1
 
-    model.params = center
-    model.net_state = engine.mean_net_state()
-    model.opt_state = engine.mean_opt_state()
+    _adopt_center()  # final weights = center + consensus momentum
 
     last_val = recorder.val_records[-1] if recorder.val_records else {}
     out = {
@@ -393,6 +403,7 @@ def _run_distributed(
     step = 0
     n_exchanges = 0
     center_vals: list[dict] = []
+    center_stats: dict | None = None
     while model.epoch < model.n_epochs:
         epoch = model.epoch
         recorder.start_epoch()
@@ -476,6 +487,15 @@ def _run_distributed(
         )
         if checkpoint_dir:
             model.save(checkpoint_dir, recorder)
+        center_stats = server.stats()
+        if verbose:
+            print(
+                f"EASGD center: {center_stats['exchanges']} exchanges, "
+                f"mean wait {center_stats['mean_wait_s'] * 1e3:.1f}ms "
+                f"(max {center_stats['max_wait_s'] * 1e3:.1f}ms), "
+                f"mean hold {center_stats['mean_hold_s'] * 1e3:.1f}ms",
+                flush=True,
+            )
         server.stop()
 
     last_val = recorder.val_records[-1] if recorder.val_records else {}
@@ -492,6 +512,9 @@ def _run_distributed(
         # empty elsewhere) — the server-semantics metric
         "center_vals": center_vals,
         "center_val": center_vals[-1] if center_vals else None,
+        # server backpressure snapshot (process 0 only): queue wait /
+        # lock hold per exchange — the single-center scaling signal
+        "center_stats": center_stats,
         "epoch_times": recorder.epoch_times,
         "recorder": recorder,
         "model": model,
