@@ -192,15 +192,20 @@ def run(
     step = 0
     n_exchanges = 0
 
-    def _adopt_center() -> None:
-        """Quiesce, then set the model's state to the center weights +
-        consensus net/opt state.  The fence first: the mean_* calls
-        dispatch per-leaf multi-device programs, and racing them
-        against in-flight train/exchange programs can starve XLA:CPU's
-        rendezvous on low-core hosts (value reads are the only honest
-        fence on this image — see base.py)."""
+    def _quiesce() -> None:
+        """Fence in-flight train/exchange programs before dispatching
+        another multi-device program (per-leaf means, validation):
+        the race can starve XLA:CPU's rendezvous on low-core hosts,
+        and value reads are the only honest fence on this image — see
+        base.py.  The flush materializes pending train metrics; the
+        center read fences the last elastic exchange."""
         recorder.flush()
         _ = float(jax.tree.leaves(center)[0].reshape(-1)[0])
+
+    def _adopt_center() -> None:
+        """Quiesce, then set the model's state to the center weights +
+        consensus net/opt state."""
+        _quiesce()
         model.params = center
         model.net_state = engine.mean_net_state()
         model.opt_state = engine.mean_opt_state()
@@ -269,6 +274,7 @@ def run(
 
         if data.n_batch_val:
             # server semantics: validate the CENTER weights
+            _quiesce()
             l, e, e5 = engine.validate(
                 data, params=center, net_state=engine.mean_net_state()
             )

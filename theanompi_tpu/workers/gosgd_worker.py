@@ -287,10 +287,16 @@ def run(
 
         if data.n_batch_val:
             # per-replica validation (reference: each process reports
-            # on its own shard of the val set)
+            # on its own shard of the val set).  Flush first: any
+            # multi-device dispatch racing the unfenced last train
+            # scan can starve XLA:CPU's rendezvous on low-core hosts
+            recorder.flush()
             l, e, e5 = engine.validate(data)
             recorder.val_error(l, e, e5)
 
+        # end_epoch flushes pending metrics — the train scan is fenced
+        # past this point; drain/_adopt_best read score VALUES, fencing
+        # the gossip programs they race
         recorder.end_epoch(epoch)
         model.adjust_hyperp(epoch + 1)
         if checkpoint_dir:
