@@ -133,6 +133,71 @@ def test_bn_train_eval(rng):
     )
 
 
+def test_bn_onepass_variance_large_mean(rng):
+    """ADVICE r3: the one-pass E[x^2]-E[x]^2 variance must stay
+    well-conditioned when |mean| >> std (e.g. a BN over un-normalized
+    inputs), where catastrophic cancellation would bite in low
+    precision.  Compared against the two-pass form in fp64."""
+    x = rng.normal(loc=300.0, scale=0.5, size=(64, 8, 8, 3)).astype(
+        np.float32
+    )
+    layer = BN()
+    params, state, _ = layer.init(KEY, (8, 8, 3))
+    _, new_state = layer.apply(params, state, jnp.asarray(x), train=True)
+    # two-pass reference in fp64; momentum 0.9 over init var 1.0:
+    # state = 0.9 * 1.0 + 0.1 * batch_var
+    v64 = x.reshape(-1, 3).astype(np.float64).var(0)
+    got = (np.asarray(new_state["var"], np.float64) - 0.9) / 0.1
+    # the UNSHIFTED one-pass form lost ~50% relative here (measured:
+    # 0.13 abs on var=0.25 at mean=300); the shifted form is tight
+    np.testing.assert_allclose(got, v64, rtol=1e-3)
+    # and on normalized-scale inputs it is tight too
+    xn = rng.normal(0.0, 1.0, (64, 8, 8, 3)).astype(np.float32)
+    _, sn = layer.apply(params, state, jnp.asarray(xn), train=True)
+    np.testing.assert_allclose(
+        (np.asarray(sn["var"], np.float64) - 0.9) / 0.1,
+        xn.reshape(-1, 3).astype(np.float64).var(0),
+        rtol=1e-4,
+    )
+
+
+def test_bn_custom_vjp_matches_autodiff(rng):
+    """The one-pass BN backward (custom_vjp, ops/layers.py) must equal
+    plain autodiff of a two-pass BN: dx, dscale, doffset, through an
+    arbitrary downstream nonlinearity."""
+    x = rng.normal(1.0, 2.0, (8, 5, 5, 6)).astype(np.float32)
+    layer = BN()
+    params, state, _ = layer.init(KEY, (5, 5, 6))
+    params = {
+        "scale": jnp.asarray(rng.normal(1, 0.2, (6,)).astype(np.float32)),
+        "offset": jnp.asarray(rng.normal(0, 0.2, (6,)).astype(np.float32)),
+    }
+
+    def loss_new(p, xx):
+        y, _ = layer.apply(p, state, xx, train=True)
+        return jnp.sum(jnp.sin(y))
+
+    def loss_ref(p, xx):
+        xf = xx.astype(jnp.float32)
+        mean = jnp.mean(xf, (0, 1, 2))
+        var = jnp.var(xf, (0, 1, 2))
+        y = (xf - mean) * jax.lax.rsqrt(var + layer.eps)
+        return jnp.sum(jnp.sin(y * p["scale"] + p["offset"]))
+
+    gp_n, gx_n = jax.grad(loss_new, argnums=(0, 1))(params, jnp.asarray(x))
+    gp_r, gx_r = jax.grad(loss_ref, argnums=(0, 1))(params, jnp.asarray(x))
+    np.testing.assert_allclose(gx_n, gx_r, atol=2e-5)
+    np.testing.assert_allclose(gp_n["scale"], gp_r["scale"], rtol=2e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(gp_n["offset"], gp_r["offset"], rtol=2e-4,
+                               atol=1e-5)
+    # bf16 activations: cotangent dtype must follow the primal
+    gx_b = jax.grad(loss_new, argnums=1)(
+        params, jnp.asarray(x).astype(jnp.bfloat16)
+    )
+    assert gx_b.dtype == jnp.bfloat16
+
+
 def test_dropout(rng):
     x = jnp.ones((1000, 32))
     layer = Dropout(0.4)

@@ -505,9 +505,9 @@ def flash_attention_tpu(
     # ragged t (e.g. a T_loc=68 ring shard) and fail deep in Mosaic
     # lowering instead (ADVICE r2).
     if block_q is None:
-        block_q = _auto_block(q.shape[2])
+        block_q = _auto_block(q.shape[2], q.dtype)
     if block_k is None:
-        block_k = _auto_block(k.shape[2])
+        block_k = _auto_block(k.shape[2], k.dtype)
     if not block_q or not block_k:
         if interpret:
             # the interpreter has no Mosaic alignment constraint; the
@@ -526,19 +526,23 @@ def flash_attention_tpu(
     return _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret)
 
 
-def _auto_block(t: int) -> int | None:
+def _auto_block(t: int, dtype=None) -> int | None:
     """Largest kernel block for a T: the full axis when it fits in one
     block, else the biggest power-of-two divisor — measured on v5e
     (8L/1024d, T2048): 1024-blocks run the train step 1.5x faster
     than 256-blocks (110 vs 169 ms/step); 2048-blocks exceed VMEM.
 
-    Only sublane-aligned blocks qualify (multiple of 16 — the bf16
-    sublane tile): the block is a Mosaic tile dimension, and a ragged
-    size (e.g. a T_loc=68 ring shard) can fail lowering instead of
-    falling back — callers treat ``None`` as "use the dense path"
-    (ADVICE r2)."""
+    Only sublane-aligned blocks qualify: the block is a Mosaic tile
+    dimension, and a ragged size (e.g. a T_loc=68 ring shard) can fail
+    lowering instead of falling back — callers treat ``None`` as "use
+    the dense path" (ADVICE r2).  The sublane tile is dtype-keyed
+    (ADVICE r3): 8 rows for fp32, 16 for bf16 — so small fp32
+    sequences like T=8/24/40 stay kernel-eligible."""
+    import numpy as np
+
+    sub = 8 if dtype is not None and np.dtype(dtype).itemsize >= 4 else 16
     if t <= 1024:
-        return t if t % 16 == 0 else None
+        return t if t % sub == 0 else None
     for s in (1024, 512, 256):
         if t % s == 0:
             return s
@@ -550,7 +554,7 @@ def flash_attention(q, k, v, *, causal=True, sm_scale=None):
     math elsewhere.  Differentiable on both paths — the TPU kernel
     carries a custom_vjp with Pallas backward kernels."""
     t, t_k = q.shape[2], k.shape[2]
-    bq, bk = _auto_block(t), _auto_block(t_k)
+    bq, bk = _auto_block(t, q.dtype), _auto_block(t_k, k.dtype)
     if _HAVE_PALLAS and _on_tpu(q) and bq and bk:
         return flash_attention_tpu(
             q, k, v, causal=causal, sm_scale=sm_scale,

@@ -19,6 +19,7 @@ extra shared variables).
 from __future__ import annotations
 
 import math
+from functools import partial
 from typing import Any, Callable, Optional, Sequence
 
 import jax
@@ -262,6 +263,94 @@ class LRN(Layer):
         return (x.astype(jnp.float32) / denom).astype(x.dtype), state
 
 
+def _bn_stats(xf, axes):
+    """SHIFTED one-pass batch statistics: sum(x-c) and sum((x-c)^2)
+    reduce together, so XLA emits a SINGLE fused read of the
+    activation instead of the sequential mean -> var(x - mean) pair
+    (jnp.var depends on the mean, forcing a second full pass).  BN
+    stat reductions are ~1/3 of a ResNet-50 train step on v5e
+    (profiled).  The per-channel shift ``c`` (one probe element, an
+    O(C) gather) bounds the classic E[x^2]-E[x]^2 cancellation when
+    |mean| >> std — e.g. a BN over raw un-normalized inputs — because
+    E[(x-c)^2] ~ var + (mean-c)^2 and (mean-c) is O(std) for any
+    in-distribution probe (ADVICE r3; regression test:
+    test_layers.test_bn_onepass_variance_large_mean).  The subtract
+    fuses into the same read; the pass count is unchanged."""
+    n = math.prod(xf.shape[a] for a in axes)
+    probe = tuple(0 if a in axes else slice(None)
+                  for a in range(xf.ndim))
+    c = lax.stop_gradient(xf[probe])
+    xc = xf - c
+    s1 = jnp.sum(xc, axes)
+    s2 = jnp.sum(xc * xc, axes)
+    d = s1 / n
+    mean = c + d
+    var = jnp.maximum(s2 / n - d * d, 0.0)
+    return mean, var, n
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _bn_train(x, scale, offset, axes, eps):
+    """Train-mode BN core with a hand-written one-pass backward.
+
+    Autodiff of the stats+normalize graph leaves XLA with FOUR
+    backward reductions over the full activation (d_scale, d_offset,
+    d_mean, d_var) scheduled behind a chain of sequential dependencies
+    (var depends on mean), which on v5e materialized as ~20% of the
+    ResNet-50 step in two-pass reduction reads (docs/PERFORMANCE.md
+    "Known ceilings", r3).  The custom backward needs only TWO
+    channel reductions — sum(dy) and sum(dy*x_hat) — computed
+    adjacently so XLA multi-output-fuses them into ONE read of dy,
+    then one elementwise pass for dx.  Math is the standard BN
+    backward (Ioffe & Szegedy 2015, eqs. in appendix):
+      dx = (scale*r) * (dy - mean(dy) - x_hat * mean(dy*x_hat))
+    Residuals save x in its ORIGINAL dtype (bf16 on the MXU path) so
+    activation memory does not double, and ``y`` is returned in
+    x.dtype FROM INSIDE the custom_vjp so the incoming cotangent is
+    bf16 too — with the cast outside, the upstream backward fusions
+    had to materialize a full fp32 dy (102 MB/layer at the
+    56x56x256 stages, profiled as the (f32,bf16) double-output
+    fusions, r4); fp32 math happens in-register inside the fused
+    passes either way."""
+    xf = x.astype(jnp.float32)
+    mean, var, _ = _bn_stats(xf, axes)
+    r = lax.rsqrt(var + eps)
+    y = (xf - mean) * r * scale + offset
+    return y.astype(x.dtype), mean, var
+
+
+def _bn_train_fwd(x, scale, offset, axes, eps):
+    xf = x.astype(jnp.float32)
+    mean, var, _ = _bn_stats(xf, axes)
+    r = lax.rsqrt(var + eps)
+    y = (xf - mean) * r * scale + offset
+    return (y.astype(x.dtype), mean, var), (x, mean, r, scale)
+
+
+def _bn_train_bwd(axes, eps, res, cts):
+    dy, dmean_ct, dvar_ct = cts
+    x, mean, r, scale = res
+    xf = x.astype(jnp.float32)
+    dyf = dy.astype(jnp.float32)   # in-register upcast, fused
+    n = math.prod(xf.shape[a] for a in axes)
+    xhat = (xf - mean) * r
+    # the two backward reductions, adjacent -> one fused read of dy
+    s_dy = jnp.sum(dyf, axes)
+    s_dyx = jnp.sum(dyf * xhat, axes)
+    dx = (scale * r) * (dyf - s_dy / n - xhat * (s_dyx / n))
+    # cotangents of the mean/var outputs (the running-stat EMA path).
+    # The train loss never reads the new running stats, so these are
+    # structural zeros folded into the same elementwise pass — kept
+    # for correctness of any exotic caller that does differentiate
+    # through the stats.  (var's clamp-at-0 subgradient is taken as
+    # the unclamped branch; the clamp only binds at var==0.)
+    dx = dx + dmean_ct / n + dvar_ct * (2.0 / n) * (xf - mean)
+    return dx.astype(x.dtype), s_dyx, s_dy
+
+
+_bn_train.defvjp(_bn_train_fwd, _bn_train_bwd)
+
+
 class BN(Layer):
     """Batch normalization with running statistics (reference: ``BN``).
 
@@ -284,27 +373,23 @@ class BN(Layer):
         axes = self.axis if self.axis is not None else tuple(range(x.ndim - 1))
         if isinstance(axes, int):  # bare-int axis stays valid (jnp did)
             axes = (axes,)
-        xf = x.astype(jnp.float32)
+        # normalize negatives: the probe index in _bn_stats matches
+        # positions positionally, and axes are a static jit constant
+        axes = tuple(a % x.ndim for a in axes)
         if train:
-            # one-pass stats: E[x] and E[x^2] reduce together, so XLA
-            # emits a SINGLE fused read of the activation instead of the
-            # sequential mean -> var(x - mean) pair (jnp.var depends on
-            # the mean, forcing a second full pass).  BN stat reductions
-            # are ~1/3 of a ResNet-50 train step on v5e (profiled); the
-            # fp32 accumulate keeps E[x^2] - E[x]^2 well-conditioned for
-            # normalized activations.
-            n = math.prod(xf.shape[a] for a in axes)
-            s1 = jnp.sum(xf, axes)
-            s2 = jnp.sum(xf * xf, axes)
-            mean = s1 / n
-            var = jnp.maximum(s2 / n - mean * mean, 0.0)
+            # y comes back already in x.dtype (see _bn_train: keeping
+            # the cast inside the vjp keeps the cotangent bf16)
+            y, mean, var = _bn_train(
+                x, params["scale"], params["offset"], axes, self.eps
+            )
             m = self.momentum
             state = {
                 "mean": m * state["mean"] + (1 - m) * mean,
                 "var": m * state["var"] + (1 - m) * var,
             }
-        else:
-            mean, var = state["mean"], state["var"]
+            return y, state
+        xf = x.astype(jnp.float32)
+        mean, var = state["mean"], state["var"]
         y = (xf - mean) * lax.rsqrt(var + self.eps)
         y = y * params["scale"] + params["offset"]
         return y.astype(x.dtype), state

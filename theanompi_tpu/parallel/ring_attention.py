@@ -198,11 +198,11 @@ def ring_attention(
     if impl is None:
         impl = (
             "flash"
-            if (_HAVE_PALLAS and _on_tpu(q) and _auto_block(t_loc))
+            if (_HAVE_PALLAS and _on_tpu(q) and _auto_block(t_loc, q.dtype))
             else "dense"
         )
     if impl == "flash":
-        block = _auto_block(t_loc)
+        block = _auto_block(t_loc, q.dtype)
         if block is None:
             raise ValueError(
                 f"impl='flash' needs a blockable shard length; "

@@ -120,9 +120,17 @@ def _latest_xplanes(trace_dir: str) -> list[str]:
 
 def is_collective(op_name: str) -> bool:
     name = op_name.lower()
-    # fused collectives keep the collective op's name in the fusion
-    # name only for collective fusions; plain "fusion.N" is compute
-    return any(m in name for m in COLLECTIVE_MARKERS)
+    # fusions are compute even when the fused producer's name embeds a
+    # collective token (e.g. an "all_gather...fusion" elementwise
+    # epilogue is mostly compute — counting it as comm skews the
+    # attribution, ADVICE r3); real collective ops are never fusions
+    if "fusion" in name:
+        return False
+    # anchor on the HLO instruction-name prefix ("psum_invariant.7" ->
+    # "psum_invariant"), so a compute op whose suffix merely mentions
+    # a collective doesn't misclassify
+    prefix = name.split(".", 1)[0]
+    return any(m in prefix for m in COLLECTIVE_MARKERS)
 
 
 def _merge_intervals(iv: list[tuple[int, int]]) -> list[tuple[int, int]]:

@@ -24,6 +24,7 @@ exchange time, matching the reference's measurement.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Any, Sequence
 
@@ -447,7 +448,12 @@ def _run_distributed(
                 server.center_tree(),
                 jax.tree.map(lambda x: x.sharding, local_params),
             )
-            cvals = [model.val_iter(j, recorder)
+            # throwaway recorder: the center sweep is process-0-only
+            # bookkeeping — folding its wall time into the shared
+            # recorder would inflate process 0's epoch/val timings
+            # relative to the other workers (ADVICE r3)
+            center_rec = Recorder(verbose=False)
+            cvals = [model.val_iter(j, center_rec)
                      for j in range(data.n_batch_val)]
             cl, ce, ce5 = (float(sum(v) / len(v)) for v in zip(*cvals))
             model.params = local_params
@@ -480,7 +486,14 @@ def _run_distributed(
     # would kill slower workers' pending exchanges mid-run
     tcp.close()
     if server is not None:
-        if not server.wait_all_stopped(timeout=600.0) and verbose:
+        # TM_EASGD_STOP_TIMEOUT_S: how long the center waits for every
+        # worker's 'stop' before tearing down anyway — the bound on how
+        # long a DEAD worker can hold the shutdown (fault drills set it
+        # low; production default tolerates slow epochs)
+        stop_timeout = float(
+            os.environ.get("TM_EASGD_STOP_TIMEOUT_S", "600")
+        )
+        if not server.wait_all_stopped(timeout=stop_timeout) and verbose:
             print(
                 "EASGD center: timed out waiting for all workers to "
                 "stop; shutting down anyway",

@@ -536,6 +536,18 @@ def _run_distributed(
                     continue
                 if s > best_score or (s == best_score and r < best_pid):
                     best_pid, best_score = r, s
+            if not complete_view:
+                # operator-visible: a timed-out peer read means NOBODY
+                # may save this epoch (the best-scorer might be among
+                # those who saw an incomplete view too) — log it so a
+                # silent run of skipped mid-run saves is diagnosable
+                # (ADVICE r3)
+                print(
+                    f"[gosgd {pid}] epoch {epoch}: peer score read "
+                    f"timed out; skipping mid-run checkpoint election "
+                    f"(next epoch retries)",
+                    flush=True,
+                )
             if complete_view and best_pid == pid:
                 model.save(checkpoint_dir, recorder)
                 with open(os.path.join(
