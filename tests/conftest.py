@@ -17,8 +17,10 @@ import os
 
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
+    # 16 virtual devices: most tests use the first 8; the true-4-D
+    # llama layout (dp=2 x tp=2 x sp=2 x pp=2, VERDICT r3 #3) needs 16
     os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
+        _flags + " --xla_force_host_platform_device_count=16"
     ).strip()
 # Framework-level device discovery (theanompi_tpu.parallel.mesh) reads this.
 os.environ["TM_TPU_PLATFORM"] = "cpu"
@@ -40,6 +42,14 @@ def devices8():
     devs = jax.devices("cpu")
     assert len(devs) >= 8, f"expected 8 fake devices, got {len(devs)}"
     return devs[:8]
+
+
+@pytest.fixture(scope="session")
+def devices16():
+    devs = jax.devices("cpu")
+    if len(devs) < 16:
+        pytest.skip(f"needs 16 fake devices, have {len(devs)}")
+    return devs[:16]
 
 
 @pytest.fixture()
