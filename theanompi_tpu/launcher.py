@@ -133,6 +133,33 @@ def init_distributed(
     )
 
 
+def finish_distributed(ok: bool = True) -> None:
+    """Exit a multi-process worker WITHOUT the coordination-service
+    shutdown barrier.
+
+    ``jax.distributed.shutdown`` runs a barrier over every task; when
+    a peer died mid-run (preemption, ``TM_FAULT_AT`` drills), that
+    barrier can never succeed — the error poller then HARD-ABORTS the
+    surviving processes (observed: ``client.h:80 Terminating process
+    ... another task died``) *after* they finished training and wrote
+    checkpoints, turning a completed run into exit code 1.  The async
+    rules are peer-death-tolerant BY DESIGN (the TCP center/gossip
+    planes shrug off a dead worker); teardown must be too.
+
+    Call at the very end of a distributed worker ``__main__``: flushes
+    stdio and ``os._exit``s, skipping the barrier.  Restart tooling
+    judges the run by its checkpoint + exit code, which this makes
+    truthful.  No-op under a single process (normal interpreter exit
+    is fine there)."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(0 if ok else 1)
+
+
 def main(argv: Sequence[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="tmlauncher",
@@ -165,6 +192,10 @@ def main(argv: Sequence[str] | None = None) -> int:
         **json.loads(ns.kwargs),
     )
     rule.wait()
+    if ns.coordinator is not None:
+        # never let the shutdown barrier undo a completed run (a dead
+        # peer makes it unpassable; skipping it is safe for live ones)
+        finish_distributed(ok=True)
     return 0
 
 
