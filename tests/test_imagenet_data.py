@@ -30,6 +30,8 @@ def batch_dir(tmp_path, rng, monkeypatch):
 class TestRealBatchFiles:
     def test_reads_batches(self, batch_dir):
         _, images, labels, gb = batch_dir
+        # default wire: u8 — crops stay uint8, the mean rides
+        # separately for the MODEL to subtract on device (prep_input)
         d = ImageNetData(batch_size=gb, n_replicas=1, crop=48)
         assert not d.synthetic
         assert d.n_batch_train == 6
@@ -37,9 +39,43 @@ class TestRealBatchFiles:
         d.shuffle(0)
         x, y = d.train_batch(0)
         assert x.shape == (gb, 48, 48, 3)
+        assert x.dtype == np.uint8
         assert y.shape == (gb,)
+        assert d.device_mean is not None
+        np.testing.assert_allclose(np.asarray(d.device_mean), 100.0)
+
+        # f32 wire: host subtracts the mean (the r1-r3 contract)
+        d32 = ImageNetData(
+            batch_size=gb, n_replicas=1, crop=48, u8_wire=False
+        )
+        d32.shuffle(0)
+        x32, _ = d32.train_batch(0)
+        assert x32.dtype == np.float32
+        assert d32.device_mean is None
         # mean was subtracted: values centered around -100..155
-        assert x.mean() < 50.0
+        assert x32.mean() < 50.0
+        # the two wires are the SAME numbers end to end
+        np.testing.assert_allclose(
+            x.astype(np.float32) - 100.0, x32, atol=1e-5
+        )
+
+    def test_u8_wire_rejects_float_sources(self, tmp_path, monkeypatch):
+        """The u8 wire copies into a uint8 buffer; a float .npz source
+        would be silently truncated by numpy's unsafe cast — must
+        refuse loudly (r4 code-review find)."""
+        out = tmp_path / "imagenet_batches" / "train"
+        out.mkdir(parents=True)
+        rng = np.random.default_rng(0)
+        np.savez(
+            out / "batch_000000.npz",
+            x=rng.normal(0, 1, (4, 64, 64, 3)).astype(np.float32),
+            y=np.arange(4, dtype=np.int32),
+        )
+        monkeypatch.setenv("TM_DATA_DIR", str(tmp_path))
+        d = ImageNetData(batch_size=4, n_replicas=1, crop=48)
+        d.shuffle(0)
+        with pytest.raises(ValueError, match="u8_wire"):
+            d.train_batch(0)
 
     def test_val_center_crop_deterministic(self, batch_dir):
         _, images, labels, gb = batch_dir

@@ -204,10 +204,11 @@ class TestImageNetIntegration:
         d = ImageNetData(batch_size=4, n_replicas=1, crop=24)
         d.shuffle(0)
         assert d._native_loader() is not None, "native path not engaged"
+        assert d._native_loader().raw_u8  # default wire: u8 crops
         seen = []
         for i in range(d.n_batch_train):
             x, y = d.train_batch(i)
-            assert x.shape == (4, 24, 24, 3) and x.dtype == np.float32
+            assert x.shape == (4, 24, 24, 3) and x.dtype == np.uint8
             seen.append(tuple(y))
         # every file delivered exactly once, in the shuffled order
         want = [

@@ -341,7 +341,17 @@ class ClassifierModel(TMModel):
 
     def prep_input(self, x):
         """Cast/transform the raw batch before the net sees it (default:
-        cast to compute dtype; token-id models keep ints — see lstm.py)."""
+        cast to compute dtype; token-id models keep ints — see lstm.py).
+
+        When the data object exposes ``device_mean`` (the u8 wire:
+        batches arrive as uint8 crops), the mean-subtract runs HERE on
+        device — it fuses into the first conv's input read, and the
+        host + host->device link move 4x fewer bytes."""
+        m = getattr(self.data, "device_mean", None)
+        if m is not None:
+            return x.astype(self.compute_dtype) - jnp.asarray(
+                m, self.compute_dtype
+            )
         return x.astype(self.compute_dtype)
 
     def primary_logits(self, out):

@@ -55,10 +55,20 @@ class _PrefetchThread(threading.Thread):
         for i in range(self.n_batches):
             if self._stop.is_set():
                 return
-            self.q.put(self.make_batch(i))
+            try:
+                item = self.make_batch(i)
+            except BaseException as e:  # propagate to the consumer —
+                # a dead producer must not leave train_batch blocked
+                # on an empty queue forever
+                self.q.put(e)
+                return
+            self.q.put(item)
 
     def get(self):
-        return self.q.get()
+        item = self.q.get()
+        if isinstance(item, BaseException):
+            raise item
+        return item
 
     def stop(self):
         self._stop.set()
@@ -84,6 +94,7 @@ class ImageNetData:
         seed: int = 0,
         n_train: int | None = None,
         n_val: int | None = None,
+        u8_wire: bool = True,
     ):
         self.batch_size = batch_size
         self.n_replicas = n_replicas
@@ -91,6 +102,13 @@ class ImageNetData:
         self.crop = crop
         self.prefetch_depth = prefetch_depth
         self._seed = seed
+        self._u8 = bool(u8_wire)
+        # device_mean: set (u8 wire, real files) => batches cross the
+        # host->device link as uint8 crops and the MODEL subtracts the
+        # mean on device (ClassifierModel.prep_input) — 4x fewer bytes
+        # through the host and the link, exact same numbers (u8->f32
+        # is exact).  None => fp32 batches arrive mean-subtracted.
+        self.device_mean = None
         self._epoch = 0
         self._prefetch: _PrefetchThread | None = None
         self._prefetch_pos = -1  # no prefetch in flight until shuffle()
@@ -131,6 +149,8 @@ class ImageNetData:
             if mean_file.exists()
             else np.full((1, 1, 1, 3), 128.0, np.float32)
         )
+        if self._u8:
+            self.device_mean = self._center_mean()
         self._file_perm = np.arange(len(self._train_files))
         self.n_batch_train = len(self._train_files)
         self.n_batch_val = len(self._val_files)
@@ -156,14 +176,31 @@ class ImageNetData:
 
         n, h, w, _ = x.shape
         c = self.crop
-        out = np.empty((n, c, c, 3), np.float32)
         ii, jj, flip = crop_flip_draws(
             self._seed, epoch, seq, n, h, w, c
         )
+        if self._u8:
+            self._require_u8(x)
+        out = np.empty((n, c, c, 3), np.uint8 if self._u8 else np.float32)
         for k in range(n):
             img = x[k, ii[k] : ii[k] + c, jj[k] : jj[k] + c]
             out[k] = img[:, ::-1] if flip[k] else img
+        if self._u8:
+            return out          # mean-subtract happens on device
         return out - self._center_mean()
+
+    @staticmethod
+    def _require_u8(x: np.ndarray) -> None:
+        """The u8 wire copies into a uint8 buffer — a float source
+        (e.g. a .npz written with pre-normalized pixels) would be
+        silently truncated/wrapped by numpy's unsafe cast.  Refuse
+        loudly; such datasets must use u8_wire=False."""
+        if np.asarray(x).dtype != np.uint8:
+            raise ValueError(
+                f"u8_wire needs uint8 batch files; got {x.dtype} — "
+                f"pass ImageNetData(u8_wire=False) for float sources "
+                f"(host-side mean-subtract wire)"
+            )
 
     def _center_mean(self) -> np.ndarray:
         m = self.img_mean
@@ -193,7 +230,7 @@ class ImageNetData:
     def _load_train(self, i: int):
         f = self._train_files[self._file_perm[i % len(self._file_perm)]]
         x, y = self._read_file(f)
-        x = np.asarray(x, np.float32)
+        x = np.asarray(x) if self._u8 else np.asarray(x, np.float32)
         self._check_batch(x, f)
         x = self._augment(x, self._epoch, i)
         return x, np.asarray(y, np.int32)
@@ -214,8 +251,8 @@ class ImageNetData:
                         self._train_files,
                         crop=self.crop,
                         mean=self._center_mean()[0],
+                        raw_u8=self._u8,
                         depth=self.prefetch_depth,
-                        n_threads=int(os.environ.get("TM_LOADER_THREADS", 4)),
                         seed=self._seed,
                     )
                     # same contract as _check_batch on the other paths
@@ -281,14 +318,16 @@ class ImageNetData:
         if self.synthetic:
             return self._syn.val_batch(i)
         x, y = self._read_file(self._val_files[i])
-        x = np.asarray(x, np.float32)
         y = np.asarray(y, np.int32)
         self._check_batch(x, self._val_files[i])
         c = self.crop
         off_h = (x.shape[1] - c) // 2
         off_w = (x.shape[2] - c) // 2
-        x = x[:, off_h : off_h + c, off_w : off_w + c] - self._center_mean()
-        return x, y
+        x = x[:, off_h : off_h + c, off_w : off_w + c]
+        if self._u8:
+            self._require_u8(x)
+            return np.ascontiguousarray(x), y
+        return np.asarray(x, np.float32) - self._center_mean(), y
 
 
 def write_batch_files(

@@ -75,7 +75,7 @@ def load_native() -> ctypes.CDLL | None:
             ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
             ctypes.c_int, ctypes.c_uint64,
             np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
-            ctypes.c_int,
+            ctypes.c_int, ctypes.c_int,
         ]
         lib.tm_loader_set_epoch.argtypes = [
             ctypes.c_void_p, ctypes.c_int,
@@ -86,6 +86,12 @@ def load_native() -> ctypes.CDLL | None:
         lib.tm_loader_next.argtypes = [
             ctypes.c_void_p,
             np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        ]
+        lib.tm_loader_next_u8.restype = ctypes.c_int
+        lib.tm_loader_next_u8.argtypes = [
+            ctypes.c_void_p,
+            np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
             np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
         ]
         lib.tm_loader_close.argtypes = [ctypes.c_void_p]
@@ -117,13 +123,18 @@ class NativeBatchLoader:
         mean: np.ndarray,
         *,
         depth: int = 4,
-        n_threads: int = 4,
+        n_threads: int | None = None,
         seed: int = 0,
+        raw_u8: bool = False,
     ):
         lib = load_native()
         if lib is None:
             raise RuntimeError("native library unavailable")
         self._lib = lib
+        self.raw_u8 = bool(raw_u8)
+        if n_threads is None:
+            n_threads = default_loader_threads()
+        self.n_threads = int(n_threads)
         paths = [str(f).encode() for f in files]
         blob = b"\x00".join(paths) + b"\x00"
         # probe channel count from the first header to size the mean
@@ -140,8 +151,9 @@ class NativeBatchLoader:
             np.float32,
         )
         self._h = lib.tm_loader_open(
-            blob, len(paths), crop, depth, n_threads,
+            blob, len(paths), crop, depth, self.n_threads,
             ctypes.c_uint64(seed), mean_full, mean_full.size,
+            1 if raw_u8 else 0,
         )
         if not self._h:
             raise ValueError(
@@ -163,9 +175,15 @@ class NativeBatchLoader:
 
     def next(self) -> tuple[np.ndarray, np.ndarray]:
         n, cr, _, c = self.batch_shape
-        x = np.empty((n, cr, cr, c), np.float32)
         y = np.empty((n,), np.int32)
-        rc = self._lib.tm_loader_next(self._h, x, y)
+        if self.raw_u8:
+            # u8 wire: crop+flip only; mean-subtract belongs on device
+            # (4x fewer host and host->device bytes)
+            x = np.empty((n, cr, cr, c), np.uint8)
+            rc = self._lib.tm_loader_next_u8(self._h, x, y)
+        else:
+            x = np.empty((n, cr, cr, c), np.float32)
+            rc = self._lib.tm_loader_next(self._h, x, y)
         if rc == 1:
             raise StopIteration("epoch exhausted")
         if rc != 0:
@@ -182,6 +200,16 @@ class NativeBatchLoader:
             self.close()
         except Exception:
             pass
+
+
+def default_loader_threads() -> int:
+    """TM_LOADER_THREADS, defaulting host-aware: on a 1-core host 2
+    threads beat 4 by ~20% (pread wait overlaps augment without
+    context-switch churn — measured r4: 1532 vs 1264 img/s); wide
+    hosts get a thread per core up to 8."""
+    return int(os.environ.get(
+        "TM_LOADER_THREADS", max(2, min(8, os.cpu_count() or 2))
+    ))
 
 
 # -- .tmb format helpers (shared with the pure-Python fallback path) --------

@@ -21,6 +21,7 @@
 // Exposed as a tiny C ABI consumed via ctypes (theanompi_tpu/native/
 // __init__.py) — no pybind11 dependency in this image.
 
+#include <fcntl.h>
 #include <pthread.h>
 #include <sched.h>
 #include <unistd.h>
@@ -93,7 +94,10 @@ struct Header {
 };
 
 struct Batch {
-  std::vector<float> x;
+  // fp32 wire: augmented pixels land in xf; u8 wire (raw_u8 mode,
+  // mean-subtract on device): crops land in xu
+  std::vector<float> xf;
+  std::vector<uint8_t> xu;
   std::vector<int32_t> y;
 };
 
@@ -108,16 +112,44 @@ bool read_header(const std::string& path, Header* out) {
   return ok && out->n > 0 && out->h > 0 && out->w > 0 && out->c > 0;
 }
 
+// Whole-file pread into caller buffers (labels + pixels).  POSIX read
+// avoids stdio's internal buffer copy on the ~25 MB pixel block.
+// pread may legally return short (signals, network filesystems), so
+// BOTH blocks loop until complete.
+bool pread_all(int fd, uint8_t* buf, size_t n, size_t off) {
+  size_t done = 0;
+  while (done < n) {
+    ssize_t r = ::pread(fd, buf + done, n - done, (off_t)(off + done));
+    if (r <= 0) return false;
+    done += (size_t)r;
+  }
+  return true;
+}
+
+bool read_body(const std::string& path, int32_t* labels, size_t n_labels,
+               uint8_t* px, size_t n_px) {
+  int fd = ::open(path.c_str(), O_RDONLY);
+  if (fd < 0) return false;
+  const size_t label_bytes = n_labels * sizeof(int32_t);
+  bool ok =
+      pread_all(fd, reinterpret_cast<uint8_t*>(labels), label_bytes, 20) &&
+      pread_all(fd, px, n_px, 20 + label_bytes);
+  ::close(fd);
+  return ok;
+}
+
 class Loader {
  public:
   Loader(std::vector<std::string> files, Header hdr, int crop, int depth,
-         int n_threads, uint64_t seed, std::vector<float> mean)
+         int n_threads, uint64_t seed, std::vector<float> mean,
+         bool raw_u8)
       : files_(std::move(files)),
         hdr_(hdr),
         crop_(crop),
         depth_(depth < 1 ? 1 : depth),
         seed_(seed),
-        mean_(std::move(mean)) {
+        mean_(std::move(mean)),
+        raw_u8_(raw_u8) {
     order_.resize(files_.size());
     for (size_t i = 0; i < order_.size(); ++i) order_[i] = (int)i;
     const std::vector<int> cpus = affinity_cpus();
@@ -155,7 +187,9 @@ class Loader {
 
   // Blocks until the next in-order batch is ready; copies it out.
   // Returns 0 on success, 1 past end-of-epoch, 2 on file error.
-  int next(float* x_out, int32_t* y_out) {
+  // Exactly one of x_out (fp32 wire) / xu_out (u8 wire) is non-null,
+  // matching the mode the loader was opened with.
+  int next(float* x_out, uint8_t* xu_out, int32_t* y_out) {
     std::unique_lock<std::mutex> l(m_);
     if (next_deliver_ >= (long)order_.size()) return 1;
     long want = next_deliver_;
@@ -169,8 +203,18 @@ class Loader {
     ++next_deliver_;
     cv_work_.notify_all();
     l.unlock();
-    std::memcpy(x_out, b.x.data(), b.x.size() * sizeof(float));
+    if (x_out)
+      std::memcpy(x_out, b.xf.data(), b.xf.size() * sizeof(float));
+    if (xu_out) std::memcpy(xu_out, b.xu.data(), b.xu.size());
     std::memcpy(y_out, b.y.data(), b.y.size() * sizeof(int32_t));
+    // recycle the buffers: a fresh 77 MB vector per batch costs an
+    // alloc + first-touch page-zeroing each time (measured as a large
+    // share of the single-core budget); the freelist caps live
+    // buffers at ~depth and makes steady-state allocation-free
+    {
+      std::lock_guard<std::mutex> fl(m_);
+      if ((int)free_.size() < depth_ + 2) free_.push_back(std::move(b));
+    }
     return 0;
   }
 
@@ -194,6 +238,13 @@ class Loader {
         epoch = epoch_;
       }
       Batch b;
+      {
+        std::lock_guard<std::mutex> l(m_);
+        if (!free_.empty()) {
+          b = std::move(free_.back());
+          free_.pop_back();
+        }
+      }
       bool ok = process(file_idx, epoch, seq, &b);
       {
         std::lock_guard<std::mutex> l(m_);
@@ -211,26 +262,30 @@ class Loader {
   bool process(int file_idx, int epoch, long seq, Batch* out) {
     const Header& h = hdr_;
     const size_t n_px = (size_t)h.n * h.h * h.w * h.c;
-    std::vector<int32_t> labels(h.n);
-    std::vector<uint8_t> px(n_px);
-    {
-      FILE* f = std::fopen(files_[file_idx].c_str(), "rb");
-      if (!f) return false;
-      bool ok = std::fseek(f, 20, SEEK_SET) == 0 &&
-                std::fread(labels.data(), sizeof(int32_t), h.n, f) ==
-                    (size_t)h.n &&
-                std::fread(px.data(), 1, n_px, f) == n_px;
-      std::fclose(f);
-      if (!ok) return false;
-    }
+    // per-worker scratch for the raw file: reused across batches, so
+    // steady state does no allocation and no page-zeroing (a fresh
+    // value-initialized vector memsets its ~25 MB before the read
+    // overwrites it)
+    static thread_local std::vector<uint8_t> px;
+    if (px.size() < n_px) px.resize(n_px);
+    out->y.resize(h.n);
+    if (!read_body(files_[file_idx], out->y.data(), (size_t)h.n,
+                   px.data(), n_px))
+      return false;
 
     // Augmentation draws are a PURE FUNCTION of (seed, epoch, seq, k)
     // via splitmix64 — bit-identical to the Python producer
     // (models/data/aug_rng.py), so the same logical batch gets the
     // same crops/flips whichever path serves it.
     const int cr = crop_;
-    out->x.resize((size_t)h.n * cr * cr * h.c);
-    out->y = std::move(labels);
+    const size_t out_n = (size_t)h.n * cr * cr * h.c;
+    if (raw_u8_) {
+      if (out->xu.size() != out_n) out->xu.resize(out_n);
+    } else {
+      if (out->xf.size() != out_n) out->xf.resize(out_n);
+    }
+    const int c = h.c;
+    const int rowlen = cr * c;
     // mean_ is always a full [cr, cr, c] image (Python broadcasts
     // per-channel / scalar means before the call)
     for (int k = 0; k < h.n; ++k) {
@@ -242,17 +297,37 @@ class Loader {
       const int j0 = (int)(splitmix64(base ^ 2) % (uint64_t)(h.w - cr + 1));
       const bool flip = (splitmix64(base ^ 3) & 1) != 0;
       const uint8_t* src = px.data() + (size_t)k * h.h * h.w * h.c;
-      float* dst = out->x.data() + (size_t)k * cr * cr * h.c;
       for (int i = 0; i < cr; ++i) {
-        const uint8_t* row = src + ((size_t)(i0 + i) * h.w + j0) * h.c;
-        float* drow = dst + (size_t)i * cr * h.c;
-        const float* mrow = mean_.data() + (size_t)i * cr * h.c;
-        for (int j = 0; j < cr; ++j) {
-          const uint8_t* p = row + (size_t)(flip ? cr - 1 - j : j) * h.c;
-          float* d = drow + (size_t)j * h.c;
-          const float* mp = mrow + (size_t)j * h.c;
-          for (int ch = 0; ch < h.c; ++ch)
-            d[ch] = (float)p[ch] - mp[ch];
+        const uint8_t* row = src + ((size_t)(i0 + i) * h.w + j0) * c;
+        if (raw_u8_) {
+          uint8_t* drow = out->xu.data() + ((size_t)k * cr + i) * rowlen;
+          if (!flip) {
+            std::memcpy(drow, row, (size_t)rowlen);
+          } else {
+            for (int j = 0; j < cr; ++j) {
+              const uint8_t* p = row + (size_t)(cr - 1 - j) * c;
+              uint8_t* d = drow + (size_t)j * c;
+              for (int ch = 0; ch < c; ++ch) d[ch] = p[ch];
+            }
+          }
+          continue;
+        }
+        float* drow = out->xf.data() + ((size_t)k * cr + i) * rowlen;
+        const float* mrow = mean_.data() + (size_t)i * rowlen;
+        if (!flip) {
+          // contiguous row: one u8->f32 convert-subtract sweep the
+          // compiler vectorizes (the per-pixel pointer walk defeated
+          // auto-vectorization at c=3)
+          for (int t = 0; t < rowlen; ++t)
+            drow[t] = (float)row[t] - mrow[t];
+        } else {
+          for (int j = 0; j < cr; ++j) {
+            const uint8_t* p = row + (size_t)(cr - 1 - j) * c;
+            float* d = drow + (size_t)j * c;
+            const float* mp = mrow + (size_t)j * c;
+            for (int ch = 0; ch < c; ++ch)
+              d[ch] = (float)p[ch] - mp[ch];
+          }
         }
       }
     }
@@ -270,10 +345,12 @@ class Loader {
   std::vector<std::thread> workers_;
   std::vector<int> order_;
   std::map<long, Batch> ready_;
+  std::vector<Batch> free_;   // recycled output buffers (see next())
   long next_claim_ = 0, next_deliver_ = 0, generation_ = 0;
   int epoch_ = 0;
   int pinned_ = 0;
   bool stop_ = false, failed_ = false;
+  bool raw_u8_ = false;
 };
 
 }  // namespace
@@ -282,10 +359,12 @@ extern "C" {
 
 // Opens a loader over n_files .tmb paths (NUL-separated blob).  mean
 // must be crop*crop*c floats (a full mean image; caller broadcasts).
-// Returns nullptr if any header is unreadable or inconsistent.
+// raw_u8 != 0 selects the uint8 wire (crop+flip only; mean-subtract
+// happens on DEVICE — 4x fewer host bytes end to end).  Returns
+// nullptr if any header is unreadable or inconsistent.
 void* tm_loader_open(const char* paths_blob, int n_files, int crop,
                      int depth, int n_threads, uint64_t seed,
-                     const float* mean, int mean_len) {
+                     const float* mean, int mean_len, int raw_u8) {
   std::vector<std::string> files;
   const char* p = paths_blob;
   for (int i = 0; i < n_files; ++i) {
@@ -304,7 +383,8 @@ void* tm_loader_open(const char* paths_blob, int n_files, int crop,
   if (mean_len != crop * crop * hdr.c) return nullptr;
   std::vector<float> m(mean, mean + mean_len);
   return new Loader(std::move(files), hdr, crop, depth,
-                    n_threads < 1 ? 1 : n_threads, seed, std::move(m));
+                    n_threads < 1 ? 1 : n_threads, seed, std::move(m),
+                    raw_u8 != 0);
 }
 
 void tm_loader_set_epoch(void* handle, int epoch, const int32_t* perm,
@@ -313,7 +393,12 @@ void tm_loader_set_epoch(void* handle, int epoch, const int32_t* perm,
 }
 
 int tm_loader_next(void* handle, float* x_out, int32_t* y_out) {
-  return static_cast<Loader*>(handle)->next(x_out, y_out);
+  return static_cast<Loader*>(handle)->next(x_out, nullptr, y_out);
+}
+
+// u8-wire variant (raw_u8 mode): x_out is uint8 [n, crop, crop, c].
+int tm_loader_next_u8(void* handle, uint8_t* x_out, int32_t* y_out) {
+  return static_cast<Loader*>(handle)->next(nullptr, x_out, y_out);
 }
 
 // Worker threads successfully pinned to a CPU (TM_LOADER_AFFINITY).
