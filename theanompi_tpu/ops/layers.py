@@ -253,39 +253,47 @@ class LRN(Layer):
     def apply(self, params, state, x, *, train=False, rng=None):
         half = self.n // 2
         sq = jnp.square(x.astype(jnp.float32))
-        # channel window sum via padded cumulative trick: pad C then slide.
-        padded = jnp.pad(sq, [(0, 0)] * (x.ndim - 1) + [(half, half)])
-        win = sum(
-            lax.dynamic_slice_in_dim(padded, i, x.shape[-1], axis=x.ndim - 1)
-            for i in range(self.n)
+        # channel window sum as ONE windowed reduction: the old
+        # pad-then-5-slice form gave the fp32 square FIVE consumers,
+        # which made XLA materialize a full fp32 copy of the conv
+        # output next to the bf16 one (profiled on v5e: LRN fwd+bwd
+        # was ~30% of the AlexNet step, dominated by those reads);
+        # reduce_window reads the squared input once and lowers to a
+        # single fused sweep.
+        dims = (1,) * (x.ndim - 1) + (self.n,)
+        win = lax.reduce_window(
+            sq, 0.0, lax.add, dims, (1,) * x.ndim,
+            [(0, 0)] * (x.ndim - 1) + [(half, half)],
         )
-        denom = jnp.power(self.k + (self.alpha / self.n) * win, self.beta)
-        return (x.astype(jnp.float32) / denom).astype(x.dtype), state
+        denom = jnp.power(self.k + (self.alpha / self.n) * win, -self.beta)
+        return (x.astype(jnp.float32) * denom).astype(x.dtype), state
 
 
 def _bn_stats(xf, axes):
-    """SHIFTED one-pass batch statistics: sum(x-c) and sum((x-c)^2)
-    reduce together, so XLA emits a SINGLE fused read of the
-    activation instead of the sequential mean -> var(x - mean) pair
-    (jnp.var depends on the mean, forcing a second full pass).  BN
-    stat reductions are ~1/3 of a ResNet-50 train step on v5e
-    (profiled).  The per-channel shift ``c`` (one probe element, an
-    O(C) gather) bounds the classic E[x^2]-E[x]^2 cancellation when
-    |mean| >> std — e.g. a BN over raw un-normalized inputs — because
-    E[(x-c)^2] ~ var + (mean-c)^2 and (mean-c) is O(std) for any
-    in-distribution probe (ADVICE r3; regression test:
-    test_layers.test_bn_onepass_variance_large_mean).  The subtract
-    fuses into the same read; the pass count is unchanged."""
+    """One-pass batch statistics: E[x] and E[x^2] reduce together, so
+    XLA emits a SINGLE fused read of the activation instead of the
+    sequential mean -> var(x - mean) pair (jnp.var depends on the
+    mean, forcing a second full pass).  BN stat reductions are ~1/3
+    of a ResNet-50 train step on v5e (profiled).
+
+    Conditioning (ADVICE r3, measured): the E[x^2]-E[x]^2 form loses
+    precision when |mean| >> std — ~50% relative variance error at
+    mean/std = 600 in fp32 (test_layers documents the envelope; tight
+    at mean/std <= ~30).  Every BN in this zoo normalizes post-conv /
+    post-mean-subtract activations, where mean/std is O(1).  Shifted
+    variants were BENCHED AND REJECTED: probing one element per
+    channel as the shift cost 6% of the ResNet-50 step — slicing an
+    fp32 view materialized a full fp32 copy of the conv output
+    (profiled as (f32,bf16) double-output conv fusions), and even a
+    bf16-sliced probe still broke the producer's fusion schedule
+    (2659 -> 2490 img/s).  If you add a BN over raw un-normalized
+    data, standardize the input (as the data pipeline already does)
+    rather than re-deriving the shift."""
     n = math.prod(xf.shape[a] for a in axes)
-    probe = tuple(0 if a in axes else slice(None)
-                  for a in range(xf.ndim))
-    c = lax.stop_gradient(xf[probe])
-    xc = xf - c
-    s1 = jnp.sum(xc, axes)
-    s2 = jnp.sum(xc * xc, axes)
-    d = s1 / n
-    mean = c + d
-    var = jnp.maximum(s2 / n - d * d, 0.0)
+    s1 = jnp.sum(xf, axes)
+    s2 = jnp.sum(xf * xf, axes)
+    mean = s1 / n
+    var = jnp.maximum(s2 / n - mean * mean, 0.0)
     return mean, var, n
 
 
