@@ -77,6 +77,45 @@ class TestLayoutInvariance:
             r1.train_losses, r4.train_losses, rtol=1e-4
         )
 
+    def test_chunked_head_matches_dense(self, devices8):
+        """The streamed unembed+xent head (tp.chunked_unembed_xent,
+        r4) is a layout/scheduling choice, not a math choice: forced
+        chunking must reproduce the dense head's first training-step
+        loss exactly — at tp=1 and with the vocab sharded tp=2."""
+        m_dense = build(devices8, data=1, optimizer="sgd", lr=0.5,
+                        xent_chunks=0)
+        m_chunk = build(devices8, data=1, optimizer="sgd", lr=0.5,
+                        xent_chunks=4)
+        m_tp = build(devices8, data=2, tp=2, batch_size=2,
+                     optimizer="sgd", lr=0.5, xent_chunks=4)
+        # sp=2: the chunked backward's dW is a per-seq-shard partial
+        # that must psum over the seq axis (the cotangent reduction)
+        m_sp = build(devices8, data=2, sp=2, batch_size=2,
+                     optimizer="sgd", lr=0.5, xent_chunks=4)
+        recs = [Recorder(rank=0) for _ in range(4)]
+        for m, r in zip((m_dense, m_chunk, m_tp, m_sp), recs):
+            m.train_iter(0, r)
+            r.flush()
+        assert m_chunk._n_xent_chunks == 4
+        np.testing.assert_allclose(
+            recs[0].train_losses, recs[1].train_losses, rtol=1e-5
+        )
+        for other in (2, 3):
+            np.testing.assert_allclose(
+                recs[0].train_losses, recs[other].train_losses,
+                rtol=1e-4,
+            )
+        np.testing.assert_allclose(
+            recs[0].train_errors, recs[1].train_errors, rtol=1e-6
+        )
+
+    def test_ragged_xent_chunks_rejected(self, devices8):
+        """An explicit chunk count that doesn't divide the local
+        vocab would silently drop tail vocab columns from the loss —
+        refused at compile time (r4 code-review find)."""
+        with pytest.raises(ValueError, match="xent_chunks"):
+            build(devices8, data=1, xent_chunks=3)  # vocab 32, 32%3!=0
+
     @pytest.mark.slow
     def test_first_step_loss_matches_true_4d_16dev(self, devices16):
         """VERDICT r3 #3: the TRUE 4-D product — every axis >= 2

@@ -107,6 +107,33 @@ class TestFlashKernel:
                 err_msg=f"d{name} mismatch",
             )
 
+    def test_independent_backward_blocks_same_grads(self, rng):
+        """bwd_block_q/bwd_block_k (VERDICT r3 #6 sweep knob) retile
+        the backward kernels only — gradients must be identical to the
+        shared-block path."""
+        from theanompi_tpu.ops.attention import flash_attention_tpu
+
+        q, k, v = qkv(rng)
+
+        def loss(bq, bk):
+            def f(q, k, v):
+                o = flash_attention_tpu(
+                    q, k, v, causal=True, block_q=16, block_k=16,
+                    bwd_block_q=bq, bwd_block_k=bk, interpret=True,
+                )
+                return jnp.sum(o * o)
+            return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+        g_shared = loss(None, None)
+        g_retiled = loss(8, 32)
+        for name, a, b in zip("qkv", g_shared, g_retiled):
+            # different tile orders reassociate the fp32 accumulators:
+            # identical math, ~1e-6 absolute float noise
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5,
+                err_msg=f"d{name} mismatch",
+            )
+
 
 class TestRingFlash:
     """Flash-backed ring attention (per-hop Pallas kernels + logsumexp

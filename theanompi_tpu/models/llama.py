@@ -296,7 +296,7 @@ class Llama(TMModel):
         x = x + tp_lib.row_parallel(gate * up, p["w_down"]).astype(cdtype)
         return x
 
-    def _forward(self, params, ids):
+    def _forward(self, params, ids, head=True):
         """ids [B_loc, T_loc] -> local vocab-shard logits [.., V/tp].
 
         With ``pp > 1`` and the default scattered head, logits are a
@@ -368,7 +368,15 @@ class Llama(TMModel):
                 x = self._pp_slice_tokens(last_stage_value(x))
 
         x = rms_norm(x, params["final_norm"])
-        return tp_lib.col_parallel(x, params["lm_head"]).astype(jnp.float32)
+        if not head:
+            return x
+        # logits stay in compute dtype: the xent/metric reductions
+        # upcast to fp32 INSIDE their fused reads (tp.py), so an
+        # .astype(f32) here would only materialize a second, 2x-wide
+        # copy of [N, V] in HBM (profiled at ~1 GB/step on the bench
+        # proxy).  Same values either way — the matmul already ran in
+        # compute dtype.
+        return tp_lib.col_parallel(x, params["lm_head"])
 
     def _pp_value(self, v):
         """Combine a per-stage metric across pipeline stages: with the
@@ -490,6 +498,30 @@ class Llama(TMModel):
         batch_spec = P(DATA_AXIS, SEQ_AXIS)
         optimizer = self.optimizer
 
+        # chunked-head resolution: the streamed head is a MEMORY
+        # feature — at 8B-scale vocab the [N, V] logits don't fit
+        # next to the activations — not a throughput one (benched on
+        # the 32k-vocab proxy: -1.4%, the backward's chunk recompute
+        # costs one extra head matmul).  "auto" therefore chunks only
+        # when the LOCAL vocab is >= 64k; an int pins the chunk
+        # count; 0/1 forces the dense head.
+        xc = self.config.get("xent_chunks", "auto")
+        v_loc = self.vocab // self.tp
+        if xc == "auto":
+            n_xent_chunks = (
+                tp_lib.pick_xent_chunks(v_loc) if v_loc >= 65536 else 1
+            )
+        else:
+            n_xent_chunks = max(1, int(xc or 1))
+            if v_loc % n_xent_chunks:
+                raise ValueError(
+                    f"xent_chunks={n_xent_chunks} must divide the "
+                    f"local vocab {v_loc} (vocab {self.vocab} / tp "
+                    f"{self.tp}) — a ragged chunking would silently "
+                    f"drop the tail vocab columns from the loss"
+                )
+        self._n_xent_chunks = n_xent_chunks
+
         def step(params, opt_state, x, y, lr):
             # Pre-cast params to data-VARYING before autodiff: if they
             # stayed invariant, the vma transpose of their broadcast
@@ -503,13 +535,28 @@ class Llama(TMModel):
             )
 
             def loss_fn(p):
-                logits = self._forward(p, x)
                 # LOCAL (per-data-shard) metrics: data axis stays out
                 # of autodiff (see cast above); SP/TP reductions remain
                 # part of the model math
                 yv = self._pp_targets(y)
-                loss = tp_lib.sharded_softmax_xent(logits, yv, self.vocab)
-                err = tp_lib.sharded_top1_err(logits, yv, self.vocab)
+                if n_xent_chunks > 1:
+                    # chunked head: unembed + xent streamed over vocab
+                    # chunks — full logits never hit HBM (tp.py)
+                    h = self._forward(p, x, head=False)
+                    h2 = h.reshape(-1, h.shape[-1])
+                    yf = yv.reshape(-1)
+                    loss_vec, pred = tp_lib.chunked_unembed_xent(
+                        h2, p["lm_head"], yf, self.vocab,
+                        n_xent_chunks, MODEL_AXIS,
+                    )
+                    loss = jnp.mean(loss_vec)
+                    err = jnp.mean((pred != yf).astype(jnp.float32))
+                else:
+                    logits = self._forward(p, x)
+                    loss = tp_lib.sharded_softmax_xent(
+                        logits, yv, self.vocab
+                    )
+                    err = tp_lib.sharded_top1_err(logits, yv, self.vocab)
                 loss = lax.pmean(self._pp_value(loss), SEQ_AXIS)
                 err = lax.pmean(self._pp_value(err), SEQ_AXIS)
                 return loss, err
