@@ -539,24 +539,26 @@ class Llama(TMModel):
                 # of autodiff (see cast above); SP/TP reductions remain
                 # part of the model math
                 yv = self._pp_targets(y)
+                h = self._forward(p, x, head=False)
+                h2 = h.reshape(-1, h.shape[-1])
+                yf = yv.reshape(-1)
                 if n_xent_chunks > 1:
                     # chunked head: unembed + xent streamed over vocab
                     # chunks — full logits never hit HBM (tp.py)
-                    h = self._forward(p, x, head=False)
-                    h2 = h.reshape(-1, h.shape[-1])
-                    yf = yv.reshape(-1)
                     loss_vec, pred = tp_lib.chunked_unembed_xent(
                         h2, p["lm_head"], yf, self.vocab,
                         n_xent_chunks, MODEL_AXIS,
                     )
-                    loss = jnp.mean(loss_vec)
-                    err = jnp.mean((pred != yf).astype(jnp.float32))
                 else:
-                    logits = self._forward(p, x)
-                    loss = tp_lib.sharded_softmax_xent(
-                        logits, yv, self.vocab
+                    # dense custom head: logits saved once in compute
+                    # dtype, grad matmuls get bf16 operands (autodiff
+                    # handed them an fp32 dlogits — ~52% MXU on the
+                    # lm_head dW, profiled r4)
+                    loss_vec, pred = tp_lib.dense_unembed_xent(
+                        h2, p["lm_head"], yf, self.vocab, MODEL_AXIS,
                     )
-                    err = tp_lib.sharded_top1_err(logits, yv, self.vocab)
+                loss = jnp.mean(loss_vec)
+                err = jnp.mean((pred != yf).astype(jnp.float32))
                 loss = lax.pmean(self._pp_value(loss), SEQ_AXIS)
                 err = lax.pmean(self._pp_value(err), SEQ_AXIS)
                 return loss, err

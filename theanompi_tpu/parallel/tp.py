@@ -284,24 +284,109 @@ def _chunked_head_bwd(vocab, n_chunks, axis_name, res, cts):
          _vary(jnp.zeros(w.shape, jnp.float32), vma)),
         jnp.arange(n_chunks),
     )
-    # each cotangent was computed as a LOCAL partial wherever its
-    # primal is invariant on an axis the computation varies over
-    # (x2: the model axis via the sharded w; w: the seq axis via the
-    # sequence-sharded tokens) — the same psums autodiff's
-    # broadcast-transposes would insert.  Reduce each down to its
-    # primal's vma.
-    def reduce_to_primal(ct, primal):
-        have = set(getattr(jax.typeof(ct), "vma", ()) or ())
-        want = set(getattr(jax.typeof(primal), "vma", ()) or ())
-        extra = tuple(sorted(have - want))
-        return lax.psum(ct, extra) if extra else ct
-
-    dx = reduce_to_primal(dx, x2)
-    dw = reduce_to_primal(dw, w)
+    dx = _reduce_ct_to_primal(dx, x2)
+    dw = _reduce_ct_to_primal(dw, w)
     return dx.astype(x2.dtype), dw.astype(w.dtype), None
 
 
 chunked_unembed_xent.defvjp(_chunked_head_fwd, _chunked_head_bwd)
+
+
+# -- dense unembed + xent with bf16 grad matmuls ----------------------------
+
+def _reduce_ct_to_primal(ct, primal):
+    """psum a cotangent down to its primal's vma — the reductions
+    autodiff's broadcast-transposes would insert (a cotangent computed
+    from axis-varying operands is a per-shard PARTIAL wherever the
+    primal is invariant)."""
+    have = set(getattr(jax.typeof(ct), "vma", ()) or ())
+    want = set(getattr(jax.typeof(primal), "vma", ()) or ())
+    extra = tuple(sorted(have - want))
+    return lax.psum(ct, extra) if extra else ct
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def dense_unembed_xent(x2, w, labels, vocab, axis_name):
+    """Fused LM head + softmax cross-entropy, logits MATERIALIZED ONCE
+    in compute dtype and saved for the backward.
+
+    Why not plain autodiff: the xent reductions upcast logits to fp32,
+    so autodiff hands the two big backward matmuls (dW = x2^T dlogits,
+    dx = dlogits w^T) an fp32 operand — profiled on v5e as the lm_head
+    dW running at ~52% of the MXU (fused with the Adam update,
+    ``divide_subtract_fusion``).  The manual backward computes the
+    softmax from the SAVED bf16 logits (no recompute — the chunked
+    variant's extra head matmul is what made it lose) and casts
+    dlogits to compute dtype before both matmuls, fp32 accumulation,
+    like every other grad matmul in the model.
+
+    Same signature/returns/sharding semantics as
+    ``chunked_unembed_xent`` (which remains the MEMORY-bound variant
+    for >=64k local vocab, where saving [N, V] logits is the problem).
+    """
+    out, _ = _dense_head_fwd_impl(x2, w, labels, vocab, axis_name)
+    return out
+
+
+def _dense_head_fwd_impl(x2, w, labels, vocab, axis_name):
+    v_loc = w.shape[1]
+    off = vocab_shard_info(vocab, axis_name)[1] if axis_name else 0
+    lg = x2 @ w.astype(x2.dtype)                    # [N, V_loc], bf16
+    lgf = lg.astype(jnp.float32)
+    m = jnp.max(lgf, axis=-1)
+    local = labels - off
+    hit = (local >= 0) & (local < v_loc)
+    safe = jnp.clip(local, 0, v_loc - 1)
+    tgt = jnp.take_along_axis(lgf, safe[:, None], axis=-1)[:, 0]
+    tgt = jnp.where(hit, tgt, 0.0)
+    bi = jnp.argmax(lgf, axis=-1) + off
+    if axis_name:
+        gm = lax.pmax(m, axis_name)
+        s = lax.psum(
+            jnp.sum(jnp.exp(lgf - gm[:, None]), axis=-1), axis_name
+        )
+        lse = gm + jnp.log(jnp.maximum(s, 1e-30))
+        tgt = lax.psum(tgt, axis_name)
+        # gm doubles as the global best for the argmax tie-break
+        pred = lax.pmin(jnp.where(m >= gm, bi, vocab), axis_name)
+    else:
+        s = jnp.sum(jnp.exp(lgf - m[:, None]), axis=-1)
+        lse = m + jnp.log(jnp.maximum(s, 1e-30))
+        pred = bi
+    loss_vec = lse - tgt
+    return (loss_vec, pred), (x2, w, labels, lg, lse)
+
+
+def _dense_head_fwd(x2, w, labels, vocab, axis_name):
+    return _dense_head_fwd_impl(x2, w, labels, vocab, axis_name)
+
+
+def _dense_head_bwd(vocab, axis_name, res, cts):
+    g, _ = cts                       # dpred: int output, no gradient
+    x2, w, labels, lg, lse = res
+    v_loc = w.shape[1]
+    off = vocab_shard_info(vocab, axis_name)[1] if axis_name else 0
+    p = jnp.exp(lg.astype(jnp.float32) - lse[:, None])
+    local = labels - off
+    hit = (local >= 0) & (local < v_loc)
+    safe = jnp.clip(local, 0, v_loc - 1)
+    onehot = (jnp.arange(v_loc)[None, :] == safe[:, None]) & hit[:, None]
+    dlg = (p - onehot.astype(jnp.float32)) * g.astype(jnp.float32)[:, None]
+    dlgc = dlg.astype(x2.dtype)                     # bf16 wire
+    dw = lax.dot_general(
+        x2, dlgc, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                               # [D, V_loc]
+    dx = lax.dot_general(
+        dlgc, w.astype(x2.dtype), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                               # [N, D]
+    dx = _reduce_ct_to_primal(dx, x2)
+    dw = _reduce_ct_to_primal(dw, w)
+    return dx.astype(x2.dtype), dw.astype(w.dtype), None
+
+
+dense_unembed_xent.defvjp(_dense_head_fwd, _dense_head_bwd)
 
 
 # -- spec-aware gradient reduction ------------------------------------------
