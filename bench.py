@@ -381,12 +381,24 @@ def build_classifier(which: str, batch: int | None = None,
         modelclass, cls, batch = "WResNet", WResNet, batch or 256
         cfg = {"batch_size": batch, "depth": 28, "widen": 10}
         img_bytes = 32 * 32 * 3 * 2           # CIFAR bf16
-    elif which == "alexnet":
-        # the reference's PRIMARY paper benchmark: AlexNet b128
-        # (BASELINE.md config[0]; arXiv:1605.08325 experiments)
-        from theanompi_tpu.models.alex_net import AlexNet
+    elif which in ("alexnet", "vgg16", "googlenet"):
+        # alexnet: the reference's PRIMARY paper benchmark (b128,
+        # BASELINE config 1; arXiv:1605.08325 experiments).
+        # vgg16/googlenet: BASELINE config 2 — focused runs only
+        # (TM_BENCH_MODEL): two more multi-minute compiles would push
+        # the driver's default full-bench past its budget.
+        import importlib
 
-        modelclass, cls, batch = "AlexNet", AlexNet, batch or 128
+        module, modelclass, def_b = {
+            "alexnet": ("alex_net", "AlexNet", 128),
+            "vgg16": ("vgg16", "VGG16", 64),
+            "googlenet": ("googlenet", "GoogLeNet", 128),
+        }[which]
+        cls = getattr(
+            importlib.import_module(f"theanompi_tpu.models.{module}"),
+            modelclass,
+        )
+        batch = batch or def_b
         cfg = {"batch_size": batch}
         img_bytes = 224 * 224 * 3 * 2
     else:
@@ -422,8 +434,10 @@ def bench_classifier(which: str, with_comm: bool = True) -> dict:
     """Image-classifier training images/sec/chip on the contract path.
 
     ``which``: 'resnet50' (the flagship / headline), 'wresnet'
-    (secondary classifier, CIFAR shapes), or 'alexnet' (the reference
-    paper's primary benchmark model)."""
+    (secondary classifier, CIFAR shapes), 'alexnet' (the reference
+    paper's primary benchmark model), or 'vgg16'/'googlenet'
+    (BASELINE config 2; focused TM_BENCH_MODEL runs only — excluded
+    from the default full-bench sequence for time)."""
     from theanompi_tpu.parallel import default_devices
     from theanompi_tpu.utils import Recorder
 
@@ -500,6 +514,8 @@ BENCHES = {
     "resnet50": lambda **kw: bench_classifier("resnet50", **kw),
     "wresnet": lambda **kw: bench_classifier("wresnet", **kw),
     "alexnet": lambda **kw: bench_classifier("alexnet", **kw),
+    "vgg16": lambda **kw: bench_classifier("vgg16", **kw),
+    "googlenet": lambda **kw: bench_classifier("googlenet", **kw),
     "llama": lambda **kw: bench_llama(),
     "loader": lambda **kw: bench_loader(),
     "loader_train": lambda **kw: bench_loader_train(),

@@ -5,7 +5,8 @@ docs/PERFORMANCE.md's "Known ceilings" breakdown.
 
 Usage (repo root):
 
-    python scripts/profile_flagship.py [resnet50|wresnet|alexnet] \
+    python scripts/profile_flagship.py \
+        [resnet50|wresnet|alexnet|vgg16|googlenet] \
         [--batch 128] [--steps 20]
 
 Runs the SAME contract path as bench.py (device_data_cache +
@@ -27,7 +28,8 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("model", nargs="?", default="resnet50",
-                    choices=["resnet50", "wresnet", "alexnet"])
+                    choices=["resnet50", "wresnet", "alexnet",
+                             "vgg16", "googlenet"])
     ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--steps", type=int, default=20,
                     help="scan length per dispatch (and trace window)")
