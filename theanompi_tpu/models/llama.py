@@ -1,5 +1,5 @@
-"""Llama-family decoder transformer with 4-D parallelism
-(DP x TP x SP x PP).
+"""Llama-family decoder transformer with 5-D parallelism
+(DP x TP x SP x PP x EP).
 
 New-framework scope: the reference is DP-only (SURVEY §2.2); the
 BASELINE Llama-3-8B stretch config requires tensor parallelism and
@@ -19,6 +19,16 @@ sequence parallelism, which shape this model's design:
   pipe-sharded leading dim (each stage holds ``n_layers/pp``
   consecutive layers), embed replicated, head masked to the last
   stage.  Knobs: ``pp``, ``pp_microbatches``.
+- **EP** over ``expert`` — with ``n_experts > 0`` every block's FFN
+  becomes a top-k MoE (``parallel/moe.py``); ``ep`` shards the expert
+  weights over the ``expert`` mesh axis, whose ranks are ALSO
+  data-parallel replicas (the batch shards over ``(expert, data)``
+  jointly), with routed tokens exchanged by ``all_to_all``.  Expert
+  grads average over ``data`` and scale by ``1/ep`` (the all_to_all
+  transpose already accumulated the ep group's token cotangents at
+  each owner); everything else averages over ``(expert, data)`` —
+  both through the configured wire strategy.  Knobs: ``n_experts,
+  moe_top_k, capacity_factor, ep, moe_aux_coef, moe_z_coef``.
 
 The WHOLE train step — embed, L layers, loss, backward, optimizer —
 is ONE vma-checked ``shard_map`` under ``jit``: XLA overlaps the TP
@@ -53,6 +63,7 @@ from theanompi_tpu.ops.attention import flash_attention
 from theanompi_tpu.ops import optimizers as opt_lib
 from theanompi_tpu.parallel import (
     DATA_AXIS,
+    EXPERT_AXIS,
     MODEL_AXIS,
     PIPE_AXIS,
     SEQ_AXIS,
@@ -63,6 +74,7 @@ from theanompi_tpu.parallel import (
     pipeline_apply,
     split_microbatches,
 )
+from theanompi_tpu.parallel.moe import moe_ffn
 from theanompi_tpu.parallel.ring_attention import ring_attention
 from theanompi_tpu.parallel.ulysses import ulysses_attention
 from theanompi_tpu.parallel import tp as tp_lib
@@ -126,6 +138,13 @@ class Llama(TMModel):
         self.tp = int(c.get("tp", 1))
         self.sp = int(c.get("sp", 1))
         self.pp = int(c.get("pp", 1))
+        # MoE knobs: n_experts=0 keeps the dense SwiGLU FFN
+        self.n_experts = int(c.get("n_experts", 0))
+        self.moe_top_k = int(c.get("moe_top_k", 2))
+        self.capacity_factor = float(c.get("capacity_factor", 1.25))
+        self.ep = int(c.get("ep", 1))
+        self.moe_aux_coef = float(c.get("moe_aux_coef", 0.01))
+        self.moe_z_coef = float(c.get("moe_z_coef", 0.0))
         batch = int(c.get("batch_size", 8))
         # default microbatch count: 2 per stage halves the GPipe bubble
         # vs M=S, when the local batch allows it
@@ -163,6 +182,13 @@ class Llama(TMModel):
         assert self.ffn_dim % self.tp == 0, "ffn_dim must divide by tp"
         assert self.seq_len % self.sp == 0, "seq_len must divide by sp"
         assert self.n_layers % self.pp == 0, "n_layers must divide by pp"
+        if self.n_experts:
+            assert self.n_experts % self.ep == 0, (
+                f"n_experts {self.n_experts} must divide by ep {self.ep}"
+            )
+            assert 0 < self.moe_top_k <= self.n_experts
+        else:
+            assert self.ep == 1, "ep > 1 requires n_experts > 0"
         if self.pp > 1:
             assert batch % self.pp_microbatches == 0, (
                 f"local batch {batch} must divide into "
@@ -202,10 +228,21 @@ class Llama(TMModel):
             "wv": P(None, MODEL_AXIS),
             "wo": P(MODEL_AXIS, None),
             "mlp_norm": P(None),
-            "w_gate": P(None, MODEL_AXIS),
-            "w_up": P(None, MODEL_AXIS),
-            "w_down": P(MODEL_AXIS, None),
         }
+        if self.n_experts:
+            # experts sharded over the expert axis, FFN dim over model
+            layer.update({
+                "router": P(None, None),
+                "we_gate": P(EXPERT_AXIS, None, MODEL_AXIS),
+                "we_up": P(EXPERT_AXIS, None, MODEL_AXIS),
+                "we_down": P(EXPERT_AXIS, MODEL_AXIS, None),
+            })
+        else:
+            layer.update({
+                "w_gate": P(None, MODEL_AXIS),
+                "w_up": P(None, MODEL_AXIS),
+                "w_down": P(MODEL_AXIS, None),
+            })
         if self.pp > 1:
             layers = {k: P(PIPE_AXIS, *s) for k, s in layer.items()}
         else:
@@ -230,19 +267,40 @@ class Llama(TMModel):
         keys = iter(jax.random.split(key, 4 + 9 * self.n_layers))
         layers = []
         for _ in range(self.n_layers):
-            layers.append({
+            lp = {
                 "attn_norm": jnp.ones((d,)),
                 "wq": dense(next(keys), (d, self.n_heads * hd)),
                 "wk": dense(next(keys), (d, self.n_kv_heads * hd)),
                 "wv": dense(next(keys), (d, self.n_kv_heads * hd)),
                 "wo": dense(next(keys), (self.n_heads * hd, d)),
                 "mlp_norm": jnp.ones((d,)),
-                "w_gate": dense(next(keys), (d, f)),
-                "w_up": dense(next(keys), (d, f)),
-                "w_down": dense(next(keys), (f, d)),
-            })
-            for _ in range(2):
+            }
+            if self.n_experts:
+                e = self.n_experts
+                # per-expert fan-in/out scales (the generic shape-based
+                # scale would key on E instead of D/F for 3-D tensors)
+                lp.update({
+                    "router": dense(next(keys), (d, e)),
+                    "we_gate": dense(
+                        next(keys), (e, d, f), (2.0 / (d + f)) ** 0.5
+                    ),
+                    "we_up": dense(
+                        next(keys), (e, d, f), (2.0 / (d + f)) ** 0.5
+                    ),
+                    "we_down": dense(
+                        next(keys), (e, f, d), (2.0 / (f + d)) ** 0.5
+                    ),
+                })
                 next(keys)  # keep key budget aligned (9 per layer)
+            else:
+                lp.update({
+                    "w_gate": dense(next(keys), (d, f)),
+                    "w_up": dense(next(keys), (d, f)),
+                    "w_down": dense(next(keys), (f, d)),
+                })
+                for _ in range(2):
+                    next(keys)  # keep key budget aligned (9 per layer)
+            layers.append(lp)
         if self.pp > 1:
             # stack the SAME per-layer draws (pp is a layout choice,
             # not a math choice: init must match the pp=1 model)
@@ -257,7 +315,13 @@ class Llama(TMModel):
     # -- forward (local shards) -------------------------------------------
 
     def _layer(self, p, x, pos):
-        """One decoder block on local shards: x [B, T_loc, D]."""
+        """One decoder block on local shards: x [B, T_loc, D].
+
+        With MoE enabled returns ``(x, mom)`` where ``mom`` is the
+        fp32 [2E+1] vector of this layer's aux-loss MOMENTS
+        (pick fractions f, mean router probs p, z-loss) — kept linear
+        so microbatch splits average exactly; ``_aux_from_moments``
+        forms the losses.  Dense blocks return just ``x``."""
         cdtype = self.compute_dtype
         h_loc = self.n_heads // self.tp
         hkv_loc = self.n_kv_heads // self.tp
@@ -291,12 +355,28 @@ class Llama(TMModel):
         x = x + tp_lib.row_parallel(_unheads(o), p["wo"]).astype(cdtype)
 
         xn = rms_norm(x, p["mlp_norm"])
+        if self.n_experts:
+            y, aux = moe_ffn(
+                xn, p["router"], p["we_gate"], p["we_up"], p["we_down"],
+                n_experts=self.n_experts,
+                top_k=self.moe_top_k,
+                capacity_factor=self.capacity_factor,
+                expert_axis=EXPERT_AXIS,
+                model_axis=MODEL_AXIS,
+                # aux losses globalize over the token-sharding axes
+                # (layout-invariant; set in compile_iter_fns)
+                batch_axes=(*self._dp_axes, SEQ_AXIS),
+            )
+            mom = jnp.concatenate(
+                [aux["f"], aux["p"], aux["z"][None]]
+            ).astype(jnp.float32)
+            return x + y.astype(cdtype), mom
         gate = jax.nn.silu(tp_lib.col_parallel(xn, p["w_gate"]))
         up = tp_lib.col_parallel(xn, p["w_up"])
         x = x + tp_lib.row_parallel(gate * up, p["w_down"]).astype(cdtype)
         return x
 
-    def _forward(self, params, ids, head=True):
+    def _forward(self, params, ids, head=True, with_aux=False):
         """ids [B_loc, T_loc] -> local vocab-shard logits [.., V/tp].
 
         With ``pp > 1`` and the default scattered head, logits are a
@@ -305,7 +385,11 @@ class Llama(TMModel):
         geometry) and recombine through ``_pp_value`` (pipe-pmean).
         On the ragged fallback (``_pp_scatter`` False) logits are
         instead valid on the LAST stage only (other stages hold
-        zeros-driven garbage) and ``_pp_value`` masks to it."""
+        zeros-driven garbage) and ``_pp_value`` masks to it.
+
+        ``with_aux=True`` (train loss path) additionally returns the
+        MoE aux pair [lb, z], averaged over layers and pipe-broadcast
+        (zeros when the model is dense)."""
         cdtype = self.compute_dtype
         t_loc = ids.shape[1]
         seq_idx = lax.axis_index(SEQ_AXIS)
@@ -328,9 +412,18 @@ class Llama(TMModel):
             )
             layer = jax.checkpoint(self._layer, policy=policy)
 
+        moe = bool(self.n_experts)
+        aux = jnp.zeros((2,), jnp.float32)
         if self.pp == 1:
+            moms = []
             for p in params["layers"]:
-                x = layer(p, x, pos)
+                if moe:
+                    x, mom = layer(p, x, pos)
+                    moms.append(mom)
+                else:
+                    x = layer(p, x, pos)
+            if moe:
+                aux = self._aux_from_moments(jnp.stack(moms))
         else:
             # GPipe over the pipe axis: the embed above is replicated
             # compute (only stage 0's copy feeds the chain — backward
@@ -344,14 +437,50 @@ class Llama(TMModel):
             # where-transpose zeroes garbage-stage cotangents.
             l_loc = self.n_layers // self.pp
 
-            def stage_fn(stage_params, xm):
+            stage0 = lax.axis_index(PIPE_AXIS) * l_loc
+
+            def stage_fn(stage_params, payload):
+                xm, am = (payload["x"], payload["aux"]) if moe else (
+                    payload, None
+                )
                 for i in range(l_loc):
                     p = jax.tree.map(lambda a: a[i], stage_params)
-                    xm = layer(p, xm, pos)
-                return xm
+                    if moe:
+                        xm, mom = layer(p, xm, pos)
+                        # this stage's global layer row: the moment
+                        # rows travel WITH the microbatch, so the last
+                        # stage's payload holds every layer's moments
+                        am = lax.dynamic_update_slice(
+                            am, mom[None, :], (stage0 + i, 0)
+                        )
+                    else:
+                        xm = layer(p, xm, pos)
+                return {"x": xm, "aux": am} if moe else xm
 
             xmb = split_microbatches(x, self.pp_microbatches)
+            if moe:
+                # per-layer aux MOMENTS ride the pipe alongside the
+                # activation (kept linear so the microbatch mean below
+                # is exact — the losses form after averaging)
+                xmb = {
+                    "x": xmb,
+                    "aux": jnp.zeros(
+                        (
+                            self.pp_microbatches,
+                            self.n_layers,
+                            2 * self.n_experts + 1,
+                        ),
+                        jnp.float32,
+                    ),
+                }
             ys = pipeline_apply(stage_fn, params["layers"], xmb)
+            if moe:
+                # microbatch-mean of the per-layer moments (valid on
+                # the last stage, broadcast), then form the losses —
+                # exactly the pp=1 numbers, any microbatch count
+                mom = last_stage_value(jnp.mean(ys["aux"], axis=0))
+                aux = self._aux_from_moments(mom)
+                ys = ys["x"]
             x = merge_microbatches(ys)
             if self._pp_scatter:
                 # LAST-STAGE-ONLY HEAD, cost-shared (VERDICT r2 item
@@ -369,14 +498,25 @@ class Llama(TMModel):
 
         x = rms_norm(x, params["final_norm"])
         if not head:
-            return x
+            return (x, aux) if with_aux else x
         # logits stay in compute dtype: the xent/metric reductions
         # upcast to fp32 INSIDE their fused reads (tp.py), so an
         # .astype(f32) here would only materialize a second, 2x-wide
         # copy of [N, V] in HBM (profiled at ~1 GB/step on the bench
         # proxy).  Same values either way — the matmul already ran in
         # compute dtype.
-        return tp_lib.col_parallel(x, params["lm_head"])
+        logits = tp_lib.col_parallel(x, params["lm_head"])
+        return (logits, aux) if with_aux else logits
+
+    def _aux_from_moments(self, moms):
+        """[L, 2E+1] per-layer aux moments (f, p, z — see ``_layer``)
+        -> fp32 [load-balance loss, z-loss], layer-averaged.  The
+        product ``E·Σ f·p`` forms HERE, after any microbatch
+        averaging, so pipeline microbatching never changes the loss."""
+        e = self.n_experts
+        f, p, z = moms[:, :e], moms[:, e:2 * e], moms[:, 2 * e]
+        lb = e * jnp.sum(f * p, axis=-1)
+        return jnp.stack([jnp.mean(lb), jnp.mean(z)])
 
     def _pp_value(self, v):
         """Combine a per-stage metric across pipeline stages: with the
@@ -414,15 +554,16 @@ class Llama(TMModel):
         err = tp_lib.sharded_top1_err(logits_loc, targets, self.vocab)
         # average over the data/seq shards (each computed a local mean);
         # with pp, keep only the last stage's value first
-        loss = lax.pmean(self._pp_value(loss), (DATA_AXIS, SEQ_AXIS))
-        err = lax.pmean(self._pp_value(err), (DATA_AXIS, SEQ_AXIS))
+        dp = getattr(self, "_dp_axes", (DATA_AXIS,))
+        loss = lax.pmean(self._pp_value(loss), (*dp, SEQ_AXIS))
+        err = lax.pmean(self._pp_value(err), (*dp, SEQ_AXIS))
         if not top5:
             return loss, err
         err5 = tp_lib.sharded_topk_err(logits_loc, targets, self.vocab, k=5)
         # the model-axis pmean is a numerical no-op (every shard holds
         # the same gathered candidates) but marks err5 vma-invariant
         err5 = lax.pmean(
-            self._pp_value(err5), (DATA_AXIS, SEQ_AXIS, MODEL_AXIS)
+            self._pp_value(err5), (*dp, SEQ_AXIS, MODEL_AXIS)
         )
         return loss, err, err5
 
@@ -461,7 +602,9 @@ class Llama(TMModel):
             exch_strategy or self.config.get("exch_strategy", "ici32")
         )
         if mesh is None:
-            mesh = make_mesh(model=self.tp, seq=self.sp, pipe=self.pp)
+            mesh = make_mesh(
+                model=self.tp, seq=self.sp, pipe=self.pp, expert=self.ep
+            )
         self.mesh = mesh
         assert mesh.shape[MODEL_AXIS] == self.tp, (
             f"mesh model axis {mesh.shape[MODEL_AXIS]} != tp {self.tp}"
@@ -470,20 +613,34 @@ class Llama(TMModel):
         assert mesh.shape.get(PIPE_AXIS, 1) == self.pp, (
             f"mesh pipe axis {mesh.shape.get(PIPE_AXIS, 1)} != pp {self.pp}"
         )
+        assert mesh.shape.get(EXPERT_AXIS, 1) == self.ep, (
+            f"mesh expert axis {mesh.shape.get(EXPERT_AXIS, 1)} != "
+            f"ep {self.ep}"
+        )
+        # data-parallel replicas = expert axis x data axis (EP ranks
+        # are DP replicas that additionally shard the experts)
+        n_dp = mesh.shape.get(EXPERT_AXIS, 1) * mesh.shape[DATA_AXIS]
         # the per-shard batch must be the configured batch_size: the
         # scattered head's token-slice guard (and the data pipeline's
         # shard math) are derived from it, so a mesh whose data axis
         # disagrees with build_model's n_replicas would silently slice
         # the wrong token count (ADVICE-style hazard, caught here)
         assert (
-            mesh.shape[DATA_AXIS] * int(self.config.get("batch_size", 8))
+            n_dp * int(self.config.get("batch_size", 8))
             == self.data.global_batch
         ), (
-            f"mesh data axis {mesh.shape[DATA_AXIS]} x per-replica "
+            f"mesh (expert x data) {n_dp} x per-replica "
             f"batch {self.config.get('batch_size', 8)} != global batch "
             f"{self.data.global_batch} (build_model n_replicas must "
             f"match the mesh)"
         )
+        # the DP reduction set: (expert, data) when the mesh carries an
+        # expert axis (size 1 is free), data alone on bare meshes
+        dp_axes = (
+            (EXPERT_AXIS, DATA_AXIS)
+            if EXPERT_AXIS in mesh.shape else (DATA_AXIS,)
+        )
+        self._dp_axes = dp_axes
 
         specs = self.param_specs()
         # optimizer-state layout mirrors the params': adam m/v (t is
@@ -495,7 +652,9 @@ class Llama(TMModel):
         else:  # momentum / nesterov velocity
             opt_specs = specs
         self._specs, self._opt_specs = specs, opt_specs
-        batch_spec = P(DATA_AXIS, SEQ_AXIS)
+        batch_spec = P(
+            dp_axes if len(dp_axes) > 1 else dp_axes[0], SEQ_AXIS
+        )
         optimizer = self.optimizer
 
         # chunked-head resolution: the streamed head is a MEMORY
@@ -522,24 +681,50 @@ class Llama(TMModel):
                 )
         self._n_xent_chunks = n_xent_chunks
 
+        # expert-sharded leaves exchange differently (see step below);
+        # identified once from the specs
+        def _leaf_has_expert(spec):
+            return any(
+                ax == EXPERT_AXIS
+                or (isinstance(ax, tuple) and EXPERT_AXIS in ax)
+                for ax in spec
+            )
+
+        expert_mask = jax.tree.map(
+            _leaf_has_expert, specs, is_leaf=lambda s: isinstance(s, P)
+        )
+        dp_spec = dp_axes if len(dp_axes) > 1 else dp_axes[0]
+        ep = self.ep
+
         def step(params, opt_state, x, y, lr):
-            # Pre-cast params to data-VARYING before autodiff: if they
+            # Pre-cast params to DP-VARYING before autodiff: if they
             # stayed invariant, the vma transpose of their broadcast
             # into the data-varying compute would insert an implicit
             # fp32 psum of the grads — summing (not averaging) over
             # data and bypassing the strategy's wire dtype.  With the
             # cast, grads come back as per-shard local grads and the
             # strategy's allreduce-mean below IS the DP exchange.
-            params_v = jax.tree.map(
-                lambda a: lax.pcast(a, DATA_AXIS, to="varying"), params
-            )
+            # (Expert-sharded leaves are already expert-varying; only
+            # the missing axes are cast.)
+            def pvary_dp(a):
+                need = tuple(
+                    ax for ax in dp_axes if ax not in jax.typeof(a).vma
+                )
+                return lax.pcast(a, need, to="varying") if need else a
+
+            params_v = jax.tree.map(pvary_dp, params)
 
             def loss_fn(p):
                 # LOCAL (per-data-shard) metrics: data axis stays out
                 # of autodiff (see cast above); SP/TP reductions remain
                 # part of the model math
                 yv = self._pp_targets(y)
-                h = self._forward(p, x, head=False)
+                if self.n_experts:
+                    h, aux = self._forward(
+                        p, x, head=False, with_aux=True
+                    )
+                else:
+                    h = self._forward(p, x, head=False)
                 h2 = h.reshape(-1, h.shape[-1])
                 yf = yv.reshape(-1)
                 if n_xent_chunks > 1:
@@ -561,6 +746,16 @@ class Llama(TMModel):
                 err = jnp.mean((pred != yf).astype(jnp.float32))
                 loss = lax.pmean(self._pp_value(loss), SEQ_AXIS)
                 err = lax.pmean(self._pp_value(err), SEQ_AXIS)
+                if self.n_experts:
+                    # MoE aux losses (layer-averaged in _forward,
+                    # already globally token-averaged inside moe_ffn):
+                    # load balance + optional z-loss — gradients flow
+                    # to the routers through probs
+                    loss = (
+                        loss
+                        + self.moe_aux_coef * aux[0]
+                        + self.moe_z_coef * aux[1]
+                    )
                 return loss, err
 
             # check_vma=True autodiff returns exact grads for the TP/SP
@@ -570,9 +765,23 @@ class Llama(TMModel):
             (loss, err), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(params_v)
-            grads = strat(grads, DATA_AXIS)
-            loss = lax.pmean(loss, DATA_AXIS)
-            err = lax.pmean(err, DATA_AXIS)
+            if self.n_experts:
+                # expert-sharded grads: the all_to_all transpose
+                # already summed the ep group's token cotangents at
+                # each owner, so the global mean over e*d replicas is
+                # (mean over data) / ep; every other leaf averages
+                # over the full (expert, data) replica set
+                def exch(g, is_exp):
+                    if is_exp:
+                        g = strat(g, DATA_AXIS)
+                        return (g / ep).astype(g.dtype) if ep > 1 else g
+                    return strat(g, dp_spec)
+
+                grads = jax.tree.map(exch, grads, expert_mask)
+            else:
+                grads = strat(grads, dp_spec)
+            loss = lax.pmean(loss, dp_axes)
+            err = lax.pmean(err, dp_axes)
             params, opt_state = optimizer.update(params, grads, opt_state, lr)
             return params, opt_state, loss, err
 
@@ -660,9 +869,16 @@ class Llama(TMModel):
         specs, opt_specs = self._specs, self._opt_specs
         rep = NamedSharding(self.mesh, P())
 
+        d_size = self.mesh.shape[DATA_AXIS]
+        has_exp = EXPERT_AXIS in self.mesh.shape
+
         def make_scan(length: int):
             def scan_steps(params, opt_state, step, seqs, perm, lr):
+                # flat DP replica index, expert-major — must match the
+                # batch spec's (expert, data) shard ordering
                 dme = lax.axis_index(DATA_AXIS)
+                if has_exp:
+                    dme = lax.axis_index(EXPERT_AXIS) * d_size + dme
                 sme = lax.axis_index(SEQ_AXIS)
                 nb = perm.shape[0] // gb
 

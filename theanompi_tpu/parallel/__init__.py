@@ -15,6 +15,7 @@ from theanompi_tpu.parallel.mesh import (
     MODEL_AXIS,
     SEQ_AXIS,
     PIPE_AXIS,
+    EXPERT_AXIS,
     num_devices,
 )
 from theanompi_tpu.parallel.pp import (
@@ -33,6 +34,13 @@ from theanompi_tpu.parallel.exchange import (
     gossip_matrix_round,
     replica_consistency_delta,
 )
+from theanompi_tpu.parallel.moe import (
+    aux_moments,
+    load_balance_loss,
+    moe_capacity,
+    moe_ffn,
+    router_topk,
+)
 from theanompi_tpu.parallel.strategies import (
     ExchangeStrategy,
     get_strategy,
@@ -47,6 +55,7 @@ __all__ = [
     "MODEL_AXIS",
     "SEQ_AXIS",
     "PIPE_AXIS",
+    "EXPERT_AXIS",
     "num_devices",
     "pipeline_apply",
     "last_stage_value",
@@ -63,4 +72,9 @@ __all__ = [
     "ExchangeStrategy",
     "get_strategy",
     "STRATEGIES",
+    "aux_moments",
+    "load_balance_loss",
+    "moe_capacity",
+    "moe_ffn",
+    "router_topk",
 ]

@@ -42,7 +42,7 @@ def _cast(tree: PyTree, dtype) -> PyTree:
 
 def allreduce_mean(
     tree: PyTree,
-    axis_name: str,
+    axis_name: str | tuple[str, ...],
     *,
     wire_dtype=None,
     two_phase: bool = False,
@@ -58,18 +58,26 @@ def allreduce_mean(
     with ``False`` a single psum is emitted (the ``nccl*`` analogue).
     XLA usually picks the best algorithm either way — the knob exists
     to preserve the reference's strategy surface and for A/B profiling.
+
+    ``axis_name`` may be a tuple of mesh axes — the reduction then
+    spans their product (the MoE case: non-expert grads average over
+    ``(expert, data)`` while expert-sharded grads average over
+    ``data`` alone).
     """
-    n = lax.axis_size(axis_name)
+    axes = axis_name if isinstance(axis_name, tuple) else (axis_name,)
+    n = 1
+    for a in axes:
+        n *= lax.axis_size(a)
 
     def one(x):
         orig = x.dtype
         w = x if wire_dtype is None else x.astype(wire_dtype)
         if two_phase and w.shape and w.shape[0] % n == 0:
             # reduce_scatter over leading dim, then all_gather back.
-            part = lax.psum_scatter(w, axis_name, scatter_dimension=0, tiled=True)
-            w = lax.all_gather(part, axis_name, axis=0, tiled=True)
+            part = lax.psum_scatter(w, axes, scatter_dimension=0, tiled=True)
+            w = lax.all_gather(part, axes, axis=0, tiled=True)
         else:
-            w = lax.psum(w, axis_name)
+            w = lax.psum(w, axes)
         return (w / n).astype(orig)
 
     return jax.tree.map(one, tree)

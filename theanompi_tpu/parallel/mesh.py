@@ -14,6 +14,11 @@ Axis conventions (used throughout the framework):
   predecessor ``theano_alexnet`` had a 2-GPU model-parallel AlexNet).
 - ``seq``   — sequence/context parallelism for ring attention
   (new-framework scope; Llama-3-8B stretch config).
+- ``expert`` — expert parallelism for MoE layers (new-framework
+  scope).  Batches shard over ``(expert, data)`` jointly — EP ranks
+  are data-parallel replicas that additionally shard the expert
+  weights and exchange routed tokens over an ``all_to_all`` — so a
+  size-1 expert axis (the default) is exactly the classic mesh.
 """
 
 from __future__ import annotations
@@ -29,6 +34,7 @@ DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
 PIPE_AXIS = "pipe"
+EXPERT_AXIS = "expert"
 
 
 def default_devices() -> list[jax.Device]:
@@ -51,37 +57,44 @@ def make_mesh(
     model: int = 1,
     seq: int = 1,
     pipe: int = 1,
+    expert: int = 1,
     *,
     devices: Sequence[jax.Device] | None = None,
 ) -> Mesh:
-    """Build a ``Mesh`` with ``(pipe, data, model, seq)`` axes.
+    """Build a ``Mesh`` with ``(pipe, expert, data, model, seq)`` axes.
 
-    ``data=None`` means "all remaining devices after pipe×model×seq".
-    On a real slice the device order from ``jax.devices()`` already
-    follows the physical torus, so contiguous reshaping keeps the
-    ``model`` and ``seq`` axes on nearest-neighbour ICI links (these
-    axes carry the latency-sensitive collectives: TP psums and
-    ring-attention ppermutes), while ``data`` — bandwidth-bound but
-    latency-tolerant allreduces — spans an outer dimension and
+    ``data=None`` means "all remaining devices after
+    pipe×expert×model×seq".  On a real slice the device order from
+    ``jax.devices()`` already follows the physical torus, so
+    contiguous reshaping keeps the ``model`` and ``seq`` axes on
+    nearest-neighbour ICI links (these axes carry the
+    latency-sensitive collectives: TP psums and ring-attention
+    ppermutes), while ``data`` — bandwidth-bound but latency-tolerant
+    allreduces — and ``expert`` — the MoE token ``all_to_all``,
+    bandwidth-bound, once per MoE layer — span outer dimensions and
     ``pipe`` — one activation hop per pipeline tick, the least
     latency-sensitive traffic — spans the outermost (on a multi-host
     pod it may even cross DCN).
     """
     devs = list(devices) if devices is not None else default_devices()
     n = len(devs)
-    if pipe * model * seq > n:
+    if pipe * expert * model * seq > n:
         raise ValueError(
-            f"pipe*model*seq={pipe * model * seq} exceeds {n} devices"
+            f"pipe*expert*model*seq={pipe * expert * model * seq} "
+            f"exceeds {n} devices"
         )
     if data is None:
-        data = n // (pipe * model * seq)
-    want = pipe * data * model * seq
+        data = n // (pipe * expert * model * seq)
+    want = pipe * expert * data * model * seq
     if want > n:
         raise ValueError(
-            f"mesh {pipe}x{data}x{model}x{seq}={want} exceeds {n} devices"
+            f"mesh {pipe}x{expert}x{data}x{model}x{seq}={want} "
+            f"exceeds {n} devices"
         )
-    grid = np.array(devs[:want]).reshape(pipe, data, model, seq)
-    return Mesh(grid, (PIPE_AXIS, DATA_AXIS, MODEL_AXIS, SEQ_AXIS))
+    grid = np.array(devs[:want]).reshape(pipe, expert, data, model, seq)
+    return Mesh(
+        grid, (PIPE_AXIS, EXPERT_AXIS, DATA_AXIS, MODEL_AXIS, SEQ_AXIS)
+    )
 
 
 def data_axis(mesh: Mesh) -> int:

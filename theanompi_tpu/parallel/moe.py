@@ -1,0 +1,222 @@
+"""Mixture-of-Experts FFN with expert parallelism over the ``expert``
+mesh axis (new-framework scope — SURVEY §2.2 row "EP/MoE", absent
+upstream; the TPU-native design follows the GShard/Switch capacity
+formulation because it is the one that keeps every shape static for
+XLA).
+
+Design:
+
+- **Routing** is a per-token softmax over ``E`` experts in fp32 with
+  deterministic top-k selection; the selected gates are renormalized
+  to sum to one (the Mixtral convention) so an all-identical-experts
+  MoE reproduces its dense FFN exactly — the anchor the unit tests
+  assert.
+- **Dispatch** is capacity-based and *slot-major*: every token's
+  1st-choice slot is ranked before any token's 2nd choice, positions
+  come from one cumulative sum over a [k·N, E] one-hot, and tokens
+  beyond an expert's capacity ``C`` are dropped (their combine weight
+  is zero — the residual stream carries them unchanged, as in Switch).
+  The buffers are built by ONE int32 scatter + ONE row gather instead
+  of the [N, E, C] one-hot einsums of the original GShard formulation
+  — same math, none of the O(N·E·C) HBM traffic.
+- **Expert parallelism**: with the ``expert`` mesh axis sized ``ep``,
+  each device owns ``E/ep`` experts; one ``lax.all_to_all`` ships the
+  per-expert capacity buffers to the owning devices and a second one
+  ships the outputs back — XLA rides these on ICI like every other
+  collective.  Expert weights compose with **TP** (``model`` axis) the
+  Megatron way: gate/up column-sharded on the FFN dim, down row-sharded
+  with the closing psum.
+- **Aux losses**: the Switch load-balance loss
+  ``E · Σ_e f_e · P_e`` (== 1 at perfect balance, any k) and the
+  router z-loss ``mean(logsumexp(logits)²)``, returned separately so
+  the model applies its own coefficients.
+
+Capacity per device-expert is ``C = ceil(cf · k · N / E)`` rounded up
+to a multiple of 8 (TPU sublane) where ``N`` is the LOCAL token count:
+drops are layout-dependent exactly as in GShard (each shard ranks its
+own tokens).  ``cf >= E/k`` guarantees zero drops (C == N) — the
+setting the cross-layout invariance tests use.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from theanompi_tpu.parallel.mesh import EXPERT_AXIS, MODEL_AXIS
+
+
+def moe_capacity(
+    n_tokens: int, n_experts: int, top_k: int, capacity_factor: float
+) -> int:
+    """Static per-expert capacity for ``n_tokens`` local tokens."""
+    c = int(-(-capacity_factor * top_k * n_tokens // n_experts))
+    c = -(-c // 8) * 8  # sublane-align the buffer's token dim
+    return max(8, min(c, n_tokens))
+
+
+def router_topk(x2, w_router, top_k: int, renormalize: bool = True):
+    """fp32 router: returns (gates [N,k], expert ids [N,k], probs
+    [N,E], logits [N,E]).  ``x2`` is [N, D]."""
+    logits = x2.astype(jnp.float32) @ w_router.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    gates, eidx = lax.top_k(probs, top_k)          # [N, k]
+    if renormalize:
+        gates = gates / jnp.sum(gates, axis=-1, keepdims=True)
+    return gates, eidx, probs, logits
+
+
+def aux_moments(eidx, probs, n_experts: int, batch_axes=()):
+    """The load-balance loss's LINEAR moments: ``f`` [E] — fraction of
+    (token, slot) picks routed to each expert (a constant wrt the
+    gradient, as in Switch) — and ``p`` [E] — mean router probability.
+
+    ``batch_axes`` names the mesh axes the token batch is sharded
+    over: ``f`` and ``p`` are then GLOBAL means (two [E]-sized
+    pmeans).  This makes the downstream product the true global
+    balance objective — and exactly layout-invariant, where the
+    per-shard product (mean_s Σ f_s·p_s) carries an f/p covariance
+    term that changes with the sharding."""
+    n, k = eidx.shape
+    counts = jnp.sum(
+        jax.nn.one_hot(eidx, n_experts, dtype=jnp.float32), axis=(0, 1)
+    )
+    f = lax.stop_gradient(counts) / (n * k)
+    p = jnp.mean(probs, axis=0)
+    if batch_axes:
+        f = lax.pmean(f, batch_axes)
+        p = lax.pmean(p, batch_axes)
+    return f, p
+
+
+def load_balance_loss(eidx, probs, n_experts: int, batch_axes=()):
+    """Switch-style aux loss over all k picks: ``E · Σ_e f_e · P_e``
+    (see ``aux_moments``).  Equals 1.0 when both are uniform."""
+    f, p = aux_moments(eidx, probs, n_experts, batch_axes)
+    return n_experts * jnp.sum(f * p)
+
+
+def router_z_loss(logits, batch_axes=()):
+    """``mean(logsumexp(logits)²)`` — keeps router logits from
+    drifting large (ST-MoE); coefficient applied by the caller.
+    Globally token-averaged when ``batch_axes`` is given."""
+    z = jnp.mean(jnp.square(jax.scipy.special.logsumexp(logits, axis=-1)))
+    return lax.pmean(z, batch_axes) if batch_axes else z
+
+
+def moe_ffn(
+    x,
+    w_router,
+    we_gate,
+    we_up,
+    we_down,
+    *,
+    n_experts: int,
+    top_k: int = 2,
+    capacity_factor: float = 1.25,
+    expert_axis: str | None = EXPERT_AXIS,
+    model_axis: str | None = MODEL_AXIS,
+    batch_axes: tuple = (),
+    renormalize: bool = True,
+):
+    """MoE SwiGLU FFN on local token shards (call inside shard_map).
+
+    - ``x``: [B, T_loc, D] activations (any float dtype; expert
+      matmuls run in ``x.dtype``, routing/combine in fp32).
+    - ``w_router``: [D, E] replicated.
+    - ``we_gate``/``we_up``: [E_loc, D, F_loc]; ``we_down``:
+      [E_loc, F_loc, D] — expert-sharded over ``expert_axis``,
+      FFN-dim-sharded over ``model_axis`` (either may be ``None`` /
+      size-1 for a replicated layout).
+
+    Returns ``(y [B, T_loc, D], aux)`` with ``aux = {"lb": load
+    balance loss, "z": router z-loss, "f": [E] pick fractions, "p":
+    [E] mean router probs}``, all globalized over ``batch_axes`` (the
+    mesh axes sharding the token batch) so they are exactly
+    layout-invariant — see ``load_balance_loss``.  ``f``/``p`` are the
+    LINEAR moments behind ``lb``: a caller that splits one batch into
+    microbatches (pipeline parallelism) should average them across the
+    microbatches first and form ``E·Σ f·p`` after, which keeps the
+    loss independent of the microbatch count too.
+    """
+    b, t, d = x.shape
+    n = b * t
+    e = n_experts
+    x2 = x.reshape(n, d)
+
+    ep = lax.axis_size(expert_axis) if expert_axis is not None else 1
+    assert e % ep == 0, f"n_experts {e} must divide by ep {ep}"
+    assert we_gate.shape[0] == e // ep, (
+        f"expert leaf holds {we_gate.shape[0]} experts, expected "
+        f"{e}/{ep} = {e // ep}"
+    )
+    c = moe_capacity(n, e, top_k, capacity_factor)
+
+    gates, eidx, probs, logits = router_topk(
+        x2, w_router, top_k, renormalize
+    )
+    f, p = aux_moments(eidx, probs, e, batch_axes)
+    aux = {
+        "f": f,
+        "p": p,
+        "lb": e * jnp.sum(f * p),
+        "z": router_z_loss(logits, batch_axes),
+    }
+
+    # -- slot-major dispatch plan (all int32, one cumsum) ------------------
+    # slot-major flatten: slot j's block holds every token's j-th pick,
+    # so capacity ranks all 1st choices before any 2nd choice
+    flat_e = eidx.T.reshape(-1)                       # [k*N]
+    onehot = (
+        flat_e[:, None] == jnp.arange(e, dtype=flat_e.dtype)[None, :]
+    ).astype(jnp.int32)                               # [k*N, E]
+    pos = jnp.take_along_axis(
+        jnp.cumsum(onehot, axis=0) - 1, flat_e[:, None], axis=1
+    )[:, 0]                                           # rank within expert
+    keep = pos < c
+    dest = jnp.where(keep, flat_e * c + pos, e * c)   # e*c = drop sentinel
+    tok = jnp.arange(top_k * n, dtype=jnp.int32) % n  # slot-major token id
+
+    # inverse plan: which token fills each (expert, capacity) slot
+    # (0 = empty; only the sentinel slot ever collides)
+    src = jnp.zeros((e * c + 1,), jnp.int32).at[dest].set(tok + 1)
+    src = src[: e * c]
+    filled = src > 0
+    buf = jnp.where(
+        filled[:, None],
+        x2[jnp.maximum(src - 1, 0)],
+        jnp.zeros((), x2.dtype),
+    ).reshape(e, c, d)
+
+    # -- ship buffers to the expert owners ---------------------------------
+    if ep > 1:
+        # [E, C, D] -> [E/ep, ep*C, D]: each device keeps its own
+        # experts' rows from every peer in the expert group
+        buf = lax.all_to_all(
+            buf, expert_axis, split_axis=0, concat_axis=1, tiled=True
+        )
+
+    # -- expert SwiGLU (batched matmuls; TP over the FFN dim) --------------
+    g = jnp.einsum("ecd,edf->ecf", buf, we_gate.astype(buf.dtype))
+    u = jnp.einsum("ecd,edf->ecf", buf, we_up.astype(buf.dtype))
+    out = jnp.einsum(
+        "ecf,efd->ecd", jax.nn.silu(g) * u, we_down.astype(buf.dtype)
+    )
+    if model_axis is not None:
+        out = lax.psum(out, model_axis)               # close row-parallel
+
+    # -- ship outputs home + weighted combine ------------------------------
+    if ep > 1:
+        out = lax.all_to_all(
+            out, expert_axis, split_axis=1, concat_axis=0, tiled=True
+        )
+    out_pad = jnp.concatenate(
+        [out.reshape(e * c, d), jnp.zeros((1, d), out.dtype)]
+    )
+    contrib = out_pad[dest].astype(jnp.float32)       # dropped -> zero row
+    w = gates.T.reshape(-1) * keep                    # [k*N] fp32
+    y = jnp.sum(
+        (contrib * w[:, None]).reshape(top_k, n, d), axis=0
+    )
+    return y.astype(x.dtype).reshape(b, t, d), aux

@@ -74,40 +74,56 @@ def pipeline_apply(
     """Run ``M`` microbatches through the stage chain.
 
     - ``stage_fn(stage_params, x) -> y`` — one stage's compute; input
-      and output must share shape/dtype (the inter-stage activation).
-    - ``x_microbatches`` — [M, ...] real data on stage 0 (other stages'
-      copies are ignored).
-    - returns [M, ...] outputs, VALID ON THE LAST STAGE ONLY.
+      and output must share structure/shape/dtype (the inter-stage
+      activation).
+    - ``x_microbatches`` — a pytree (a bare array is the common case)
+      whose leaves are [M, ...]: real data on stage 0 (other stages'
+      copies are ignored).  A multi-leaf payload lets a stage thread
+      side values down the pipe — e.g. the MoE aux loss accumulates
+      stage by stage alongside the activation.
+    - returns the same structure of [M, ...] outputs, VALID ON THE
+      LAST STAGE ONLY.
 
     Must be called inside ``shard_map`` with ``axis_name`` in the mesh.
     """
     s = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
-    m = x_microbatches.shape[0]
+    m = jax.tree.leaves(x_microbatches)[0].shape[0]
     ticks = m + s - 1
     # chain (not ring): stage i feeds i+1; stage 0 receives zeros
     perm = [(i, i + 1) for i in range(s - 1)]
 
     # the carry becomes stage-varying after one tick; mark it varying
     # up front so the scan types close (vma-checked shard_map)
-    x_microbatches = _pvary(x_microbatches, axis_name)
-    ys0 = jnp.zeros_like(x_microbatches)
-    recv0 = jnp.zeros_like(x_microbatches[0])
+    x_microbatches = jax.tree.map(
+        lambda a: _pvary(a, axis_name), x_microbatches
+    )
+    ys0 = jax.tree.map(jnp.zeros_like, x_microbatches)
+    recv0 = jax.tree.map(lambda a: jnp.zeros_like(a[0]), x_microbatches)
 
     def tick(carry, t):
         recv, ys = carry
         # stage 0 injects microbatch t (clipped during drain ticks)
-        feed = x_microbatches[jnp.clip(t, 0, m - 1)]
-        inp = jnp.where(idx == 0, feed, recv)
+        tc = jnp.clip(t, 0, m - 1)
+        inp = jax.tree.map(
+            lambda a, r: jnp.where(idx == 0, a[tc], r),
+            x_microbatches, recv,
+        )
         out = stage_fn(stage_params, inp)
-        sent = lax.ppermute(out, axis_name, perm)
+        sent = jax.tree.map(
+            lambda o: lax.ppermute(o, axis_name, perm), out
+        )
         # last stage completes microbatch t-(s-1) at tick t
         w = jnp.clip(t - (s - 1), 0, m - 1)
         valid = jnp.logical_and(t >= s - 1, idx == s - 1)
-        slot = lax.dynamic_index_in_dim(ys, w, 0, keepdims=False)
-        ys = lax.dynamic_update_index_in_dim(
-            ys, jnp.where(valid, out, slot), w, 0
-        )
+
+        def put(ys_leaf, out_leaf):
+            slot = lax.dynamic_index_in_dim(ys_leaf, w, 0, keepdims=False)
+            return lax.dynamic_update_index_in_dim(
+                ys_leaf, jnp.where(valid, out_leaf, slot), w, 0
+            )
+
+        ys = jax.tree.map(put, ys, out)
         return (sent, ys), None
 
     (_, ys), _ = lax.scan(tick, (recv0, ys0), jnp.arange(ticks))

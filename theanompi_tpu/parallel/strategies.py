@@ -40,7 +40,7 @@ class ExchangeStrategy:
     wire_dtype: Optional[Any]       # None = native dtype on the wire
     two_phase: bool                  # reduce_scatter+all_gather vs psum
 
-    def __call__(self, tree, axis_name: str):
+    def __call__(self, tree, axis_name: str | tuple[str, ...]):
         return allreduce_mean(
             tree,
             axis_name,
