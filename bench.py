@@ -130,9 +130,15 @@ def _vs_baseline(key_name: str, value: float):
     return None
 
 
-def bench_llama() -> dict:
+def bench_llama(moe: bool = False) -> dict:
     """Decoder-LM training tokens/sec/chip with the fused
-    flash-attention kernels (baseline key Llama_tokens_per_sec_per_chip)."""
+    flash-attention kernels (baseline key Llama_tokens_per_sec_per_chip).
+
+    ``moe=True`` (focused ``TM_BENCH_MODEL=moe`` runs): same proxy
+    geometry with the FFN as a top-2 MoE over 8 experts of HALF the
+    dense width — the same ACTIVE FFN FLOPs per token as the dense
+    proxy, so the throughput delta vs the llama entry is the measured
+    cost of routing + dispatch (no baseline key; first captured r4)."""
     from theanompi_tpu.models.llama import Llama
     from theanompi_tpu.parallel import default_devices, make_mesh
     from theanompi_tpu.utils import Recorder, enable_compile_cache
@@ -150,6 +156,11 @@ def bench_llama() -> dict:
         exch_strategy="ici16",
         device_data_cache=True, steps_per_call=20,
     )
+    if moe:
+        cfg.update(
+            ffn_dim=1408, n_experts=8, moe_top_k=2,
+            capacity_factor=1.25,
+        )
     model = Llama(cfg)
     model.build_model(n_replicas=n_chips)
     model.compile_iter_fns(mesh=make_mesh(data=n_chips, devices=devices))
@@ -192,14 +203,21 @@ def bench_llama() -> dict:
             / (n_chips * peak),
             4,
         )
+    name = (
+        f"Llama-{cfg['n_layers']}L-{cfg['dim']}d"
+        + (f"-MoE-E{cfg['n_experts']}top{cfg['moe_top_k']}" if moe else "")
+    )
     return {
         "metric": (
-            f"Llama-{cfg['n_layers']}L-{cfg['dim']}d tokens/sec/chip "
+            f"{name} tokens/sec/chip "
             f"(BSP, bf16, b{cfg['batch_size']}, T{cfg['seq_len']})"
         ),
         "value": round(per_chip, 2),
         "unit": "tokens/sec/chip",
-        "vs_baseline": _vs_baseline("Llama_tokens_per_sec_per_chip", per_chip),
+        "vs_baseline": (
+            None if moe else
+            _vs_baseline("Llama_tokens_per_sec_per_chip", per_chip)
+        ),
         **extra,
     }
 
@@ -517,6 +535,7 @@ BENCHES = {
     "vgg16": lambda **kw: bench_classifier("vgg16", **kw),
     "googlenet": lambda **kw: bench_classifier("googlenet", **kw),
     "llama": lambda **kw: bench_llama(),
+    "moe": lambda **kw: bench_llama(moe=True),
     "loader": lambda **kw: bench_loader(),
     "loader_train": lambda **kw: bench_loader_train(),
 }

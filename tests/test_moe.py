@@ -143,6 +143,9 @@ class TestExpertParallelLayouts:
             dict(data=1),
             dict(ep=2, batch_size=2),
             dict(ep=2, tp=2, batch_size=2),
+            # pipelined MoE: step 2 also exercises the gradient path
+            # through the pipeline aux-moment payload
+            dict(ep=2, pp=2, batch_size=2),
         ]
         histories = []
         for lay in layouts:

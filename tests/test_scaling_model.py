@@ -120,3 +120,44 @@ def test_predict_table_runs_for_all_flagships():
             step_time_1chip=m["step_time"], param_bytes=m["param_bytes"]
         )
         assert [r["n_chips"] for r in rows] == [8, 16, 64]
+
+
+def test_moe_param_count_vs_dense():
+    """E experts of width f hold E x the dense FFN params (+ router);
+    the attention/embed terms match the dense count exactly."""
+    from theanompi_tpu.utils.scaling_model import moe_param_count
+
+    cfg = dict(dim=1024, n_layers=8, n_heads=16, n_kv_heads=8,
+               ffn_dim=2816, vocab=32000, seq_len=2048)
+    moe = dict(cfg, n_experts=8, moe_top_k=2)
+    dense = llama_param_count(cfg)
+    total = moe_param_count(moe)
+    ffn_dense = 8 * 3 * 1024 * 2816
+    router = 8 * 1024 * 8
+    assert total == dense - ffn_dense + 8 * ffn_dense + router
+
+
+def test_moe_alltoall_bytes_and_overhead():
+    """EP exchange model: zero at ep=1; scales with the remote
+    fraction; overhead fraction small for the benched proxy at ep=8
+    (the dispatch ships activations, the experts crunch D*F FLOPs)."""
+    from theanompi_tpu.utils.scaling_model import (
+        moe_alltoall_bytes,
+        moe_ep_overhead,
+    )
+
+    cfg = dict(dim=1024, n_layers=8, n_heads=16, n_kv_heads=8,
+               ffn_dim=1408, vocab=32000, seq_len=2048,
+               n_experts=8, moe_top_k=2)
+    assert moe_alltoall_bytes(cfg, batch_per_replica=4, ep=1) == 0.0
+    b2 = moe_alltoall_bytes(cfg, batch_per_replica=4, ep=2)
+    b8 = moe_alltoall_bytes(cfg, batch_per_replica=4, ep=8)
+    # (ep-1)/ep remote fraction: 8-way ships 7/4 x the 2-way bytes
+    assert math.isclose(b8 / b2, (7 / 8) / (1 / 2), rel_tol=1e-12)
+    # r4 measured MoE proxy step: 4*2048 tokens / 55.2k tok/s
+    ov = moe_ep_overhead(
+        cfg, batch_per_replica=4, ep=8,
+        step_time_1chip=4 * 2048 / 55237.0,
+    )
+    assert 0 < ov["frac_of_step"] < 0.2
+    assert ov["efficiency_no_overlap"] > 0.8

@@ -248,6 +248,86 @@ def llama_step_flops(cfg: dict, batch: int, seq_len: int | None = None,
     return dense + attn
 
 
+# --------------------------------------------------------------------------
+# MoE / expert parallelism (parallel/moe.py)
+# --------------------------------------------------------------------------
+
+
+def moe_param_count(cfg: dict) -> int:
+    """Parameter count with every FFN a MoE (models/llama.py MoE
+    layout: router [d, E] + E experts of gate/up/down at ``ffn_dim``
+    per expert)."""
+    d = int(cfg["dim"])
+    L = int(cfg["n_layers"])
+    v = int(cfg["vocab"])
+    f = int(cfg["ffn_dim"])
+    e = int(cfg["n_experts"])
+    kv = int(cfg["n_kv_heads"]) * (d // int(cfg["n_heads"]))
+    per_layer = (
+        2 * d * d + 2 * d * kv + 2 * d   # attn + norms
+        + d * e                          # router
+        + 3 * e * d * f                  # experts
+    )
+    return v * d + L * per_layer + d + d * v
+
+
+def moe_alltoall_bytes(
+    cfg: dict,
+    *,
+    batch_per_replica: int,
+    ep: int,
+    sp: int = 1,
+    capacity_factor: float = 1.25,
+    compute_bytes: int = 2,
+) -> float:
+    """Per-chip, per-step bytes the EP token exchange puts on the
+    wire: each MoE layer runs 2 all_to_alls forward (dispatch + return
+    of the [E, C, D] capacity buffers) and their 2 transposes in
+    backward, each shipping the (ep-1)/ep remote fraction."""
+    if ep <= 1:
+        return 0.0
+    from theanompi_tpu.parallel.moe import moe_capacity
+
+    d = int(cfg["dim"])
+    L = int(cfg["n_layers"])
+    e = int(cfg["n_experts"])
+    k = int(cfg.get("moe_top_k", 2))
+    n_loc = batch_per_replica * int(cfg["seq_len"]) // sp
+    c = moe_capacity(n_loc, e, k, capacity_factor)
+    rows = e * c
+    return L * 4.0 * rows * d * compute_bytes * (ep - 1) / ep
+
+
+def moe_ep_overhead(
+    cfg: dict,
+    *,
+    batch_per_replica: int,
+    ep: int,
+    sp: int = 1,
+    capacity_factor: float = 1.25,
+    step_time_1chip: float,
+    chip: ChipSpec = V5E,
+    links: int | None = None,
+) -> dict:
+    """Zero-overlap bound on the EP all_to_all cost: exchange bytes
+    over the chip's usable ICI egress vs the measured step time.
+    XLA overlaps the dispatch of layer i with compute of layer i-1,
+    so the truth sits between ``frac_of_step`` and 0 — same
+    convention as ``bsp_efficiency``."""
+    b = moe_alltoall_bytes(
+        cfg, batch_per_replica=batch_per_replica, ep=ep, sp=sp,
+        capacity_factor=capacity_factor,
+    )
+    links = ici_links_used(ep) if links is None else links
+    t = b / (links * chip.ici_link_bw)
+    return {
+        "a2a_mb_per_step": b / 2**20,
+        "t_a2a_ms": t * 1e3,
+        "frac_of_step": t / step_time_1chip,
+        "efficiency_no_overlap": step_time_1chip / (step_time_1chip + t),
+    }
+
+
 def llama_step_time(
     cfg: dict,
     *,
