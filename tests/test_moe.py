@@ -211,6 +211,101 @@ class TestExpertParallelLayouts:
         assert losses[-1] < losses[0] * 1.5
 
     @pytest.mark.slow
+    def test_moe_trains_to_dense_parity(self, devices8):
+        """Convergence drill (SURVEY §4 methodology, applied to the
+        new component): an E=4 top-2 MoE with experts of HALF the
+        dense FFN width (same ACTIVE width, 4x the FFN params) must
+        reach the dense model's loss plateau on the synthetic LM task
+        — if routing, aux balancing, or the expert grad path were
+        off, the extra capacity would hurt instead of matching."""
+        data_cfg = dict(n_train=256, n_val=64)
+        dense = build_moe(
+            devices8, data=2, batch_size=2, n_experts=0, ep=1,
+            ffn_dim=64, **data_cfg,
+        )
+        moe = build_moe(
+            devices8, ep=2, batch_size=2, n_experts=4, ffn_dim=32,
+            capacity_factor=1.25, **data_cfg,
+        )
+        finals = {}
+        for name, m in (("dense", dense), ("moe", moe)):
+            rec = Recorder(rank=0)
+            nb = m.data.n_batch_train
+            for epoch in range(6):
+                m.data.shuffle(epoch)
+                for i in range(nb):
+                    m.train_iter(i, rec)
+            rec.flush()
+            finals[name] = float(
+                np.mean(np.array(rec.train_losses)[-nb:])
+            )
+        assert finals["moe"] < finals["dense"] + 0.15, finals
+        # and it actually learned (init loss is ln(32) ~ 3.47)
+        assert finals["moe"] < 1.5, finals
+
+    @pytest.mark.slow
+    def test_router_learns_and_keeps_balance(self):
+        """Balance dynamics of the MoE machinery itself: tokens drawn
+        from 8 clusters, experts trained to reproduce a
+        cluster-dependent target.  With the aux loss on, training
+        must both reduce the task loss and keep every expert in use
+        (no router collapse — the failure mode the lb term exists
+        to prevent)."""
+        import jax
+        import jax.numpy as jnp
+
+        from theanompi_tpu.parallel.moe import moe_ffn, router_topk
+
+        e, d, f, n = 8, 16, 32, 256
+        ks = jax.random.split(jax.random.PRNGKey(7), 8)
+        centers = jax.random.normal(ks[0], (e, d))
+        cluster = jax.random.randint(ks[1], (n,), 0, e)
+        x = (centers[cluster]
+             + 0.1 * jax.random.normal(ks[2], (n, d)))[None]  # [1,N,D]
+        target = jnp.tanh(centers)[cluster][None]
+
+        init = {
+            "router": 0.02 * jax.random.normal(ks[3], (d, e)),
+            "wg": 0.3 * jax.random.normal(ks[4], (e, d, f)),
+            "wu": 0.3 * jax.random.normal(ks[5], (e, d, f)),
+            "wd": 0.3 * jax.random.normal(ks[6], (e, f, d)),
+        }
+
+        def train(aux_coef):
+            def loss_fn(p):
+                y, aux = moe_ffn(
+                    x, p["router"], p["wg"], p["wu"], p["wd"],
+                    n_experts=e, top_k=2, capacity_factor=2.0,
+                    expert_axis=None, model_axis=None,
+                )
+                task = jnp.mean((y - target) ** 2)
+                return task + aux_coef * aux["lb"], (task, aux["lb"])
+
+            step = jax.jit(jax.value_and_grad(loss_fn, has_aux=True))
+            params = init
+            first = last = None
+            for _ in range(300):
+                (_, (task, lb)), g = step(params)
+                first = float(task) if first is None else first
+                last, lb_last = float(task), float(lb)
+                params = jax.tree.map(
+                    lambda p_, g_: p_ - 0.05 * g_, params, g
+                )
+            _, eidx, _, _ = router_topk(x[0], params["router"], 2)
+            counts = np.bincount(
+                np.asarray(eidx).reshape(-1), minlength=e
+            )
+            return first, last, lb_last, counts
+
+        # absolute assertions only: the unregularized run MAY collapse
+        # on this toy (seed/backend dependent), so nothing bets on it
+        t0_on, t_on, lb_on, c_on = train(0.05)
+        assert t_on < 0.4 * t0_on, (t0_on, t_on)
+        # the aux-regularized router keeps every expert in real use
+        assert c_on.min() >= 4, c_on
+        assert lb_on < 1.3, lb_on
+
+    @pytest.mark.slow
     def test_device_cache_scan_path_ep2(self, devices8):
         """The device-resident K-step scan indexes batches by the flat
         (expert-major) replica id — run it under ep=2 and check the

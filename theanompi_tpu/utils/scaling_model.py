@@ -255,20 +255,14 @@ def llama_step_flops(cfg: dict, batch: int, seq_len: int | None = None,
 
 def moe_param_count(cfg: dict) -> int:
     """Parameter count with every FFN a MoE (models/llama.py MoE
-    layout: router [d, E] + E experts of gate/up/down at ``ffn_dim``
-    per expert)."""
+    layout): the dense count plus, per layer, the router [d, E] and
+    the E-1 ADDITIONAL expert copies of gate/up/down (expert 1's copy
+    is the dense FFN's own)."""
     d = int(cfg["dim"])
     L = int(cfg["n_layers"])
-    v = int(cfg["vocab"])
     f = int(cfg["ffn_dim"])
     e = int(cfg["n_experts"])
-    kv = int(cfg["n_kv_heads"]) * (d // int(cfg["n_heads"]))
-    per_layer = (
-        2 * d * d + 2 * d * kv + 2 * d   # attn + norms
-        + d * e                          # router
-        + 3 * e * d * f                  # experts
-    )
-    return v * d + L * per_layer + d + d * v
+    return llama_param_count(cfg) + L * (d * e + 3 * (e - 1) * d * f)
 
 
 def moe_alltoall_bytes(
