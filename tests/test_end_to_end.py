@@ -86,6 +86,30 @@ class TestRuleAPI:
         assert result["epochs"] == 1
         assert result["final_train_loss"] is not None
 
+    def test_bsp_rule_drives_model_parallel_moe_llama(self):
+        """The rule surface honors the model's parallelism knobs: a
+        tp=2 x ep=2 MoE Llama trains through BSP().init with the
+        worker building the 4-axis-aware mesh (remaining devices
+        become dp), not just plain DP."""
+        rule = theanompi_tpu.BSP()
+        rule.init(
+            devices=list(range(8)),
+            modelfile="theanompi_tpu.models.llama",
+            modelclass="Llama",
+            launch="inprocess",
+            config=dict(
+                dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
+                ffn_dim=64, vocab=32, seq_len=32, batch_size=2,
+                n_train=32, n_val=16, compute_dtype="float32",
+                remat=False, n_epochs=1,
+                tp=2, ep=2, n_experts=4, moe_top_k=2,
+            ),
+            verbose=False,
+        )
+        result = rule.wait()
+        assert result["epochs"] == 1
+        assert result["final_train_loss"] is not None
+
 
 class TestReplicaConsistency:
     def test_params_identical_across_replicas(self):

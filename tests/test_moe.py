@@ -211,6 +211,26 @@ class TestExpertParallelLayouts:
         assert losses[-1] < losses[0] * 1.5
 
     @pytest.mark.slow
+    def test_first_step_loss_matches_5axis_16dev(self, devices16):
+        """The maximal composition — ep=2 x tp=2 x sp=2 x pp=2 in one
+        16-device mesh (MoE all_to_all + TP psums + ring SP inside
+        the pipeline scan + (expert, data) batch sharding) — must
+        reproduce the 1x1x1x1x1 first-step training loss."""
+        m1 = build_moe(devices16, data=1, optimizer="sgd", lr=0.5)
+        m5 = build_moe(
+            devices16, ep=2, tp=2, sp=2, pp=2, batch_size=2,
+            optimizer="sgd", lr=0.5,
+        )
+        r1, r5 = Recorder(rank=0), Recorder(rank=0)
+        m1.train_iter(0, r1)
+        m5.train_iter(0, r5)
+        r1.flush()
+        r5.flush()
+        np.testing.assert_allclose(
+            r1.train_losses, r5.train_losses, rtol=1e-4
+        )
+
+    @pytest.mark.slow
     def test_moe_trains_to_dense_parity(self, devices8):
         """Convergence drill (SURVEY §4 methodology, applied to the
         new component): an E=4 top-2 MoE with experts of HALF the
@@ -304,6 +324,32 @@ class TestExpertParallelLayouts:
         # the aux-regularized router keeps every expert in real use
         assert c_on.min() >= 4, c_on
         assert lb_on < 1.3, lb_on
+
+    @pytest.mark.slow
+    def test_sharded_checkpoint_cross_ep_restore(
+        self, devices8, tmp_path
+    ):
+        """Expert resharding through the checkpoint: save under
+        ep=2 x tp=2 (experts split across devices), restore into a
+        dp=2/ep=1 layout (experts replicated per DP rank) — leaves
+        identical, val loss identical."""
+        m = build_moe(devices8, ep=2, tp=2, batch_size=2)
+        rec = Recorder(verbose=False)
+        m.train_iter(0, rec)
+        m.epoch = 2
+        m.save(str(tmp_path), rec)
+
+        m2 = build_moe(devices8, data=2, ep=1, batch_size=2)
+        rec2 = Recorder(verbose=False)
+        assert m2.load(str(tmp_path), rec2)
+        assert m2.epoch == 2
+        for a, b in zip(
+            jax.tree.leaves(m.params), jax.tree.leaves(m2.params)
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        l1 = m.val_iter(0, rec)[0]
+        l2 = m2.val_iter(0, rec2)[0]
+        assert np.isclose(l1, l2, rtol=1e-5), (l1, l2)
 
     @pytest.mark.slow
     def test_device_cache_scan_path_ep2(self, devices8):

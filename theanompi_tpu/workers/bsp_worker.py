@@ -26,14 +26,34 @@ def _resolve_model(modelfile: str, modelclass: str):
     return getattr(mod, modelclass)
 
 
-def _build_mesh(devices: Sequence[Any] | None):
+def _build_mesh(devices: Sequence[Any] | None, config: dict | None = None):
+    """Mesh for the BSP run: remaining devices become the data axis
+    after the model's parallelism knobs (``tp/sp/pp/ep`` config keys,
+    the Llama-family convention) claim theirs — so
+    ``BSP().init(modelfile=...llama...)`` drives model-parallel
+    layouts through the same rule surface as plain DP."""
     devs = default_devices()
     if devices is not None:
         n = len(devices)
         if n > len(devs):
             raise ValueError(f"requested {n} devices, have {len(devs)}")
         devs = devs[:n]
-    return make_mesh(data=len(devs), devices=devs)
+    c = config or {}
+    tp, sp, pp, ep = (
+        int(c.get(k, 1)) for k in ("tp", "sp", "pp", "ep")
+    )
+    prod = tp * sp * pp * ep
+    if len(devs) % prod:
+        raise ValueError(
+            f"tp*sp*pp*ep={prod} must divide the {len(devs)} requested "
+            f"devices — a floor division would silently idle "
+            f"{len(devs) % prod} of them"
+        )
+    return make_mesh(
+        data=len(devs) // prod,
+        model=tp, seq=sp, pipe=pp, expert=ep,
+        devices=devs,
+    )
 
 
 def run(
@@ -51,12 +71,12 @@ def run(
     **extra: Any,
 ) -> dict:
     """Train ``modelclass`` under BSP; returns a summary dict."""
-    mesh = _build_mesh(devices)
-    n_replicas = mesh.shape["data"]
-
     Model = _resolve_model(modelfile, modelclass)
     cfg = dict(config or {})
     cfg.update(extra)
+    mesh = _build_mesh(devices, cfg)
+    # DP replicas = expert x data (EP ranks are DP replicas too)
+    n_replicas = mesh.shape["data"] * mesh.shape.get("expert", 1)
     if n_epochs is not None:
         cfg["n_epochs"] = n_epochs
     model = Model(cfg)
