@@ -617,9 +617,9 @@ class Llama(TMModel):
             f"mesh expert axis {mesh.shape.get(EXPERT_AXIS, 1)} != "
             f"ep {self.ep}"
         )
-        # data-parallel replicas = expert axis x data axis (EP ranks
-        # are DP replicas that additionally shard the experts)
-        n_dp = mesh.shape.get(EXPERT_AXIS, 1) * mesh.shape[DATA_AXIS]
+        from theanompi_tpu.parallel import dp_replicas
+
+        n_dp = dp_replicas(mesh)
         # the per-shard batch must be the configured batch_size: the
         # scattered head's token-slice guard (and the data pipeline's
         # shard math) are derived from it, so a mesh whose data axis

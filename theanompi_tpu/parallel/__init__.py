@@ -10,6 +10,7 @@ parameter buffers between train steps.
 from theanompi_tpu.parallel.mesh import (
     make_mesh,
     data_axis,
+    dp_replicas,
     default_devices,
     DATA_AXIS,
     MODEL_AXIS,
@@ -50,6 +51,7 @@ from theanompi_tpu.parallel.strategies import (
 __all__ = [
     "make_mesh",
     "data_axis",
+    "dp_replicas",
     "default_devices",
     "DATA_AXIS",
     "MODEL_AXIS",

@@ -100,3 +100,10 @@ def make_mesh(
 def data_axis(mesh: Mesh) -> int:
     """Size of the data-parallel axis of ``mesh``."""
     return mesh.shape[DATA_AXIS]
+
+
+def dp_replicas(mesh: Mesh) -> int:
+    """Number of data-parallel replicas of ``mesh``: expert × data —
+    EP ranks are DP replicas that additionally shard the expert
+    weights (the one place the convention is defined)."""
+    return mesh.shape.get(EXPERT_AXIS, 1) * mesh.shape[DATA_AXIS]

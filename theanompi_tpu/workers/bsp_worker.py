@@ -17,7 +17,7 @@ import os
 from typing import Any, Sequence
 
 from theanompi_tpu import launcher as _launcher
-from theanompi_tpu.parallel import make_mesh, default_devices
+from theanompi_tpu.parallel import default_devices, dp_replicas, make_mesh
 from theanompi_tpu.utils import Recorder, faults as _faults
 
 
@@ -43,6 +43,11 @@ def _build_mesh(devices: Sequence[Any] | None, config: dict | None = None):
         int(c.get(k, 1)) for k in ("tp", "sp", "pp", "ep")
     )
     prod = tp * sp * pp * ep
+    if len(devs) < prod:
+        raise ValueError(
+            f"tp*sp*pp*ep={prod} needs at least {prod} devices, "
+            f"got {len(devs)}"
+        )
     if len(devs) % prod:
         raise ValueError(
             f"tp*sp*pp*ep={prod} must divide the {len(devs)} requested "
@@ -75,8 +80,7 @@ def run(
     cfg = dict(config or {})
     cfg.update(extra)
     mesh = _build_mesh(devices, cfg)
-    # DP replicas = expert x data (EP ranks are DP replicas too)
-    n_replicas = mesh.shape["data"] * mesh.shape.get("expert", 1)
+    n_replicas = dp_replicas(mesh)
     if n_epochs is not None:
         cfg["n_epochs"] = n_epochs
     model = Model(cfg)
