@@ -263,3 +263,105 @@ def test_initializer_fans():
     xa = initializers.xavier()(KEY, (100, 200))
     limit = (6 / 300) ** 0.5
     assert float(jnp.max(jnp.abs(xa))) <= limit + 1e-6
+
+
+class TestMaxpoolTiesplit:
+    """Scatter-free maxpool backward (maxpool_tiesplit): identical
+    forward, autodiff-equal gradients when window maxima are unique,
+    mass-conserving equal split on ties."""
+
+    CONFIGS = [
+        ((3, 3), (1, 1), "SAME"),
+        ((3, 3), (2, 2), "SAME"),
+        ((2, 2), (2, 2), "VALID"),
+        ((5, 5), (3, 3), "VALID"),
+    ]
+
+    def test_forward_matches_reduce_window(self):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        from theanompi_tpu.ops.layers import maxpool_tiesplit
+
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 13, 11, 3))
+        for size, stride, pad in self.CONFIGS:
+            y = maxpool_tiesplit(x, size, stride, pad)
+            ref = lax.reduce_window(
+                x, -jnp.inf, lax.max, (1, *size, 1), (1, *stride, 1), pad
+            )
+            np.testing.assert_array_equal(np.asarray(y), np.asarray(ref))
+
+    def test_grad_matches_autodiff_when_unique(self):
+        """Distinct values in every window -> no ties -> the split
+        backward must equal select_and_scatter's exactly."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        from theanompi_tpu.ops.layers import maxpool_tiesplit
+
+        # all-distinct values guarantee unique window maxima
+        x = jnp.arange(2 * 13 * 11 * 3, dtype=jnp.float32)
+        x = jax.random.permutation(jax.random.PRNGKey(1), x)
+        x = x.reshape(2, 13, 11, 3)
+        for size, stride, pad in self.CONFIGS:
+            def f_ts(x_):
+                return jnp.sum(
+                    maxpool_tiesplit(x_, size, stride, pad) ** 2
+                )
+
+            def f_ref(x_):
+                return jnp.sum(lax.reduce_window(
+                    x_, -jnp.inf, lax.max,
+                    (1, *size, 1), (1, *stride, 1), pad,
+                ) ** 2)
+
+            g_ts = jax.grad(f_ts)(x)
+            g_ref = jax.grad(f_ref)(x)
+            np.testing.assert_allclose(
+                np.asarray(g_ts), np.asarray(g_ref), rtol=1e-6,
+                err_msg=f"{size} {stride} {pad}",
+            )
+
+    def test_tie_split_conserves_mass(self):
+        """Constant input: every window element ties.  Gradient mass
+        per window is dy (split, not duplicated or dropped)."""
+        import jax
+        import jax.numpy as jnp
+
+        from theanompi_tpu.ops.layers import maxpool_tiesplit
+
+        x = jnp.ones((1, 12, 12, 2), jnp.float32)
+        for size, stride, pad in self.CONFIGS:
+            y, vjp = jax.vjp(
+                lambda x_: maxpool_tiesplit(x_, size, stride, pad), x
+            )
+            dy = jnp.ones_like(y)
+            (dx,) = vjp(dy)
+            np.testing.assert_allclose(
+                float(jnp.sum(dx)), float(jnp.sum(dy)), rtol=1e-5,
+                err_msg=f"{size} {stride} {pad}",
+            )
+
+    def test_bf16_relu_plateau_finite(self):
+        """The motivating case: bf16 activations with zero plateaus
+        (relu) — gradients stay finite and mass-conserving."""
+        import jax
+        import jax.numpy as jnp
+
+        from theanompi_tpu.ops.layers import maxpool_tiesplit
+
+        x = jax.nn.relu(
+            jax.random.normal(jax.random.PRNGKey(2), (2, 14, 14, 4))
+        ).astype(jnp.bfloat16)
+        y, vjp = jax.vjp(
+            lambda x_: maxpool_tiesplit(x_, (3, 3), (1, 1), "SAME"), x
+        )
+        (dx,) = vjp(jnp.ones_like(y))
+        assert bool(jnp.all(jnp.isfinite(dx.astype(jnp.float32))))
+        np.testing.assert_allclose(
+            float(jnp.sum(dx.astype(jnp.float32))),
+            float(jnp.sum(jnp.ones_like(y).astype(jnp.float32))),
+            rtol=0.05,  # bf16 accumulation through the 9-way split
+        )

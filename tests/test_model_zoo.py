@@ -101,3 +101,43 @@ def test_googlenet_aux_heads(devices8):
         float(softmax_cross_entropy(out_e, y)),
         rtol=1e-6,
     )
+
+
+def test_fused_inception_matches_unfused():
+    """The fused-1x1 Inception (one wide conv + split) is the SAME
+    function as the four-branch module: copy the fused conv's weight
+    columns into the three separate convs and compare outputs."""
+    import jax
+    import jax.numpy as jnp
+
+    from theanompi_tpu.models.googlenet import _FusedInception, _inception
+
+    c1, c3r, c3, c5r, c5, cp = 8, 12, 16, 4, 8, 8
+    fused = _FusedInception(c1, c3r, c3, c5r, c5, cp)
+    plain = _inception(c1, c3r, c3, c5r, c5, cp)
+    in_shape = (10, 10, 6)
+    key = jax.random.PRNGKey(3)
+    pf, sf, out_f = fused.init(key, in_shape)
+    pp_, sp_, out_p = plain.init(key, in_shape)
+    assert out_f == out_p
+
+    # transplant fused weights into the four-branch structure:
+    # Concat params = [branch1, seq(3x3r,3x3), seq(5x5r,5x5), seq(pool,proj)]
+    # where each _conv is Sequential([Conv, Activation]) -> [conv, {}]
+    wf, bf = pf["first"]["w"], pf["first"]["b"]
+    pp_[0][0]["w"] = wf[..., :c1]
+    pp_[0][0]["b"] = bf[:c1]
+    pp_[1][0][0]["w"] = wf[..., c1:c1 + c3r]
+    pp_[1][0][0]["b"] = bf[c1:c1 + c3r]
+    pp_[1][1][0] = pf["b3"][0]
+    pp_[2][0][0]["w"] = wf[..., c1 + c3r:]
+    pp_[2][0][0]["b"] = bf[c1 + c3r:]
+    pp_[2][1][0] = pf["b5"][0]
+    pp_[3][1][0] = pf["pproj"][0]
+
+    x = jax.random.normal(jax.random.PRNGKey(4), (2, *in_shape))
+    yf, _ = fused.apply(pf, sf, x)
+    yp, _ = plain.apply(pp_, sp_, x)
+    np.testing.assert_allclose(
+        np.asarray(yf), np.asarray(yp), atol=1e-5
+    )

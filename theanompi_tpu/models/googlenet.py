@@ -12,6 +12,7 @@ returns ``(main_logits, aux1_logits, aux2_logits)``.
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 
 from theanompi_tpu.models.base import ClassifierModel
 from theanompi_tpu.models.data.imagenet import CROP, ImageNetData, N_CLASSES
@@ -48,6 +49,70 @@ def _inception(c1, c3r, c3, c5r, c5, cp):
     ])
 
 
+class _FusedInception(Layer):
+    """Inception module with the three 1x1 convs that read the SAME
+    input (branch-1, 3x3-reduce, 5x5-reduce) fused into ONE 1x1 conv,
+    split after the shared relu — identical math (relu is elementwise,
+    he() init depends only on the shared fan-in), better MXU geometry:
+    the separate convs fill 128-wide output-lane tiles at e.g.
+    64/96/16 channels (the 16-wide 5x5-reduce uses 12.5% of its
+    tile), the fused conv at c1+c3r+c5r.  The pool-proj branch reads
+    the pooled input and cannot join.  Equivalence to the unfused
+    module is asserted by
+    ``test_model_zoo.py::test_fused_inception_matches_unfused``."""
+
+    def __init__(self, c1, c3r, c3, c5r, c5, cp):
+        self.sizes = (c1, c3r, c5r)
+        self.first = Conv(
+            c1 + c3r + c5r, 1, w_init=initializers.he()
+        )
+        self.b3 = _conv(c3, 3)
+        self.b5 = _conv(c5, 5)
+        self.pool = Pool(3, 1, mode="max", pad="SAME")
+        self.pproj = _conv(cp, 1)
+
+    def init(self, key, in_shape):
+        k1, k3, k5, kp = jax.random.split(key, 4)
+        c1, c3r, c5r = self.sizes
+        p1, s1, sh1 = self.first.init(k1, in_shape)
+        p3, s3, sh3 = self.b3.init(k3, sh1[:2] + (c3r,))
+        p5, s5, sh5 = self.b5.init(k5, sh1[:2] + (c5r,))
+        pp, sp_, shp = self.pproj.init(kp, in_shape)
+        out = (in_shape[0], in_shape[1], c1 + sh3[2] + sh5[2] + shp[2])
+        return (
+            {"first": p1, "b3": p3, "b5": p5, "pproj": pp},
+            {"first": s1, "b3": s3, "b5": s5, "pproj": sp_},
+            out,
+        )
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        rngs = (
+            jax.random.split(rng, 3) if rng is not None else [None] * 3
+        )
+        c1, c3r, c5r = self.sizes
+        h, s1 = self.first.apply(
+            params["first"], state["first"], x, train=train, rng=rngs[0]
+        )
+        h = jax.nn.relu(h)
+        y3, s3 = self.b3.apply(
+            params["b3"], state["b3"], h[..., c1:c1 + c3r],
+            train=train, rng=rngs[1],
+        )
+        y5, s5 = self.b5.apply(
+            params["b5"], state["b5"], h[..., c1 + c3r:],
+            train=train, rng=rngs[2],
+        )
+        hp, _ = self.pool.apply({}, {}, x, train=train)
+        yp, sp_ = self.pproj.apply(
+            params["pproj"], state["pproj"], hp, train=train, rng=None
+        )
+        new_state = {"first": s1, "b3": s3, "b5": s5, "pproj": sp_}
+        return (
+            jnp.concatenate([h[..., :c1], y3, y5, yp], axis=-1),
+            new_state,
+        )
+
+
 def _aux_head():
     """Auxiliary classifier: avgpool 5/3 -> 1x1 conv 128 -> FC1024 -> FC."""
     return Sequential([
@@ -64,7 +129,8 @@ def _aux_head():
 class _GoogLeNetNet(Layer):
     """Trunk with two aux branch points; returns a 3-tuple in train mode."""
 
-    def __init__(self):
+    def __init__(self, fused: bool = True):
+        inc = _FusedInception if fused else _inception
         self.stem = Sequential([
             _conv(64, 7, stride=2),
             Pool(3, 2, pad="SAME"),
@@ -73,21 +139,21 @@ class _GoogLeNetNet(Layer):
             _conv(192, 3),
             LRN(),
             Pool(3, 2, pad="SAME"),
-            _inception(64, 96, 128, 16, 32, 32),     # 3a
-            _inception(128, 128, 192, 32, 96, 64),   # 3b
+            inc(64, 96, 128, 16, 32, 32),     # 3a
+            inc(128, 128, 192, 32, 96, 64),   # 3b
             Pool(3, 2, pad="SAME"),
-            _inception(192, 96, 208, 16, 48, 64),    # 4a
+            inc(192, 96, 208, 16, 48, 64),    # 4a
         ])
         self.mid = Sequential([
-            _inception(160, 112, 224, 24, 64, 64),   # 4b
-            _inception(128, 128, 256, 24, 64, 64),   # 4c
-            _inception(112, 144, 288, 32, 64, 64),   # 4d
+            inc(160, 112, 224, 24, 64, 64),   # 4b
+            inc(128, 128, 256, 24, 64, 64),   # 4c
+            inc(112, 144, 288, 32, 64, 64),   # 4d
         ])
         self.tail = Sequential([
-            _inception(256, 160, 320, 32, 128, 128),  # 4e
+            inc(256, 160, 320, 32, 128, 128),  # 4e
             Pool(3, 2, pad="SAME"),
-            _inception(256, 160, 320, 32, 128, 128),  # 5a
-            _inception(384, 192, 384, 48, 128, 128),  # 5b
+            inc(256, 160, 320, 32, 128, 128),  # 5a
+            inc(384, 192, 384, 48, 128, 128),  # 5b
             GlobalAvgPool(),
             Dropout(0.4),
             FC(N_CLASSES, w_init=initializers.normal(0.01)),
@@ -151,7 +217,9 @@ class GoogLeNet(ClassifierModel):
         super().__init__(config)
 
     def build_model(self, n_replicas: int = 1) -> None:
-        self.net = _GoogLeNetNet()
+        self.net = _GoogLeNetNet(
+            fused=bool(self.config.get("fused_inception", True))
+        )
         crop = int(self.config.get("crop", CROP))
         self.input_shape = (crop, crop, 3)
         self.data = ImageNetData(

@@ -19,6 +19,7 @@ extra shared variables).
 from __future__ import annotations
 
 import math
+import os
 from functools import partial
 from typing import Any, Callable, Optional, Sequence
 
@@ -198,8 +199,164 @@ class Conv(Layer):
         return y, state
 
 
+def _pool_explicit_pad(h, w, kh, kw, sh, sw, pad, oh, ow):
+    """Explicit (top, bottom), (left, right) padding matching
+    lax.reduce_window's 'SAME'/'VALID' conventions."""
+    if pad == "VALID":
+        return (0, 0), (0, 0)
+    th = max((oh - 1) * sh + kh - h, 0)
+    tw = max((ow - 1) * sw + kw - w, 0)
+    return (th // 2, th - th // 2), (tw // 2, tw - tw // 2)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def maxpool_tiesplit(x, size, stride, pad):
+    """Max pooling whose backward is scatter-free.
+
+    Forward: IDENTICAL to ``lax.reduce_window``-max.  Backward: for
+    each window offset, ``eq = (x[shifted] == y)`` marks the
+    attaining elements and ``dy/cnt`` routes to them — gradient mass
+    is conserved exactly; on TIES it is split equally among the
+    attaining elements where XLA's ``select_and_scatter`` gives
+    everything to the first in window order (ties are the only
+    semantic difference; the equal split is the symmetric
+    subgradient).
+
+    **Measured result: NOT the default.**  GoogLeNet's pools profile
+    at ~59% of its train step, which motivated this; but three
+    formulations all LOST to select_and_scatter on v5e (b128 focused
+    bench, select_and_scatter = 4471-4487 img/s across same-code
+    captures): scatter-style dilated-
+    pad accumulation 1138 (every add materialized an input-sized fp32
+    array), dilated gather stencil 2539 (upsampled share/y arrays
+    materialized at input size), and this phase-decomposed gather
+    3224 — its ~7 window-grid passes (cnt, share, per-phase gather,
+    interleave transpose) out-read the scatter's near-bandwidth
+    single pass.  select_and_scatter on this hardware generation is
+    simply not the serial bottleneck it is reputed to be.  Kept
+    opt-in (``TM_POOL_BWD=tiesplit``) as the measured record of the
+    experiment and for backends where the scatter IS serial.
+    """
+    return _maxpool_ts_fwd(x, size, stride, pad)[0]
+
+
+def _maxpool_ts_fwd(x, size, stride, pad):
+    y = lax.reduce_window(
+        x, -jnp.inf, lax.max, (1, *size, 1), (1, *stride, 1), pad
+    )
+    return y, (x, y)
+
+
+def _maxpool_ts_bwd(size, stride, pad, res, dy):
+    # PHASE-DECOMPOSED GATHER: every intermediate lives on the
+    # window grid (1/s^2 of the input) and dx is assembled by one
+    # reshape-interleave.  Two rejected formulations, both measured
+    # on v5e: scatter-style accumulation (k*k dilated pads summed)
+    # ran 3x SLOWER than select_and_scatter (every add materialized
+    # an input-sized fp32 array), and a dilated gather stencil 4x
+    # slower (the upsampled share/y arrays materialized at input
+    # size).  Here, for each of the s*s input phases, the windows
+    # covering a pixel are a small static set of window-grid shifts
+    # (ceil(k/s)^2 of them), so the whole backward is k^2-ish
+    # window-grid-sized fused elementwise passes.
+    x, y = res
+    kh, kw = size
+    sh, sw = stride
+    n, h, w, c = x.shape
+    oh, ow = y.shape[1], y.shape[2]
+    (pt, pb), (pl, pr) = _pool_explicit_pad(
+        h, w, kh, kw, sh, sw, pad, oh, ow
+    )
+    # pad so every phase has the same grid size (extra sliced off)
+    hp = -(-(h + pt + pb) // sh) * sh
+    wp = -(-(w + pl + pr) // sw) * sw
+    ph, pw = hp // sh, wp // sw
+    neg = jnp.asarray(-jnp.inf, x.dtype)
+    xp = jnp.pad(
+        x, ((0, 0), (pt, hp - h - pt), (pl, wp - w - pl), (0, 0)),
+        constant_values=neg,
+    )
+
+    def at_offset(oi, oj):
+        """x values each window sees at offset (oi, oj): a strided
+        slice of the padded input, shaped like y."""
+        return lax.slice(
+            xp,
+            (0, oi, oj, 0),
+            (n, oi + (oh - 1) * sh + 1, oj + (ow - 1) * sw + 1, c),
+            (1, sh, sw, 1),
+        )
+
+    # tie counts <= k*k are exact in bf16, and keeping every array in
+    # the compute dtype halves the bandwidth of a purely
+    # bandwidth-bound pass (fp32 intermediates measured ~2x slower)
+    cdt = x.dtype
+    cnt = jnp.zeros(y.shape, cdt)
+    for oi in range(kh):
+        for oj in range(kw):
+            cnt = cnt + (at_offset(oi, oj) == y).astype(cdt)
+    # every SAME/VALID window contains >= 1 real element, so the max
+    # is always attained; the guard only protects degenerate configs
+    share = (dy.astype(cdt) / jnp.maximum(cnt, jnp.asarray(1, cdt)))
+
+    # window-grid arrays padded so any (q2 - d) shift is a slice:
+    # low by the max back-shift, high to cover ph > oh phases
+    di_max, dj_max = (kh - 1) // sh, (kw - 1) // sw
+    hi_h = max(ph - oh, 0) + di_max
+    hi_w = max(pw - ow, 0) + dj_max
+    share_p = jnp.pad(
+        share, ((0, 0), (di_max, hi_h), (dj_max, hi_w), (0, 0))
+    )
+    y_p = jnp.pad(
+        y, ((0, 0), (di_max, hi_h), (dj_max, hi_w), (0, 0)),
+        constant_values=neg,
+    )
+
+    phases = []
+    for pi in range(sh):
+        for pj in range(sw):
+            # phase pixels sit at xp[(q2*sh + pi, r2*sw + pj)]
+            xph = lax.slice(
+                xp, (0, pi, pj, 0), (n, hp, wp, c), (1, sh, sw, 1)
+            )
+            acc = jnp.zeros((n, ph, pw, c), jnp.float32)
+            # windows covering this phase: origins (q2 - d)*s with
+            # d*s <= k-1-p  (window offset o = p + d*s < k)
+            for di in range((kh - 1 - pi) // sh + 1):
+                for dj in range((kw - 1 - pj) // sw + 1):
+                    sl = (
+                        slice(None),
+                        slice(di_max - di, di_max - di + ph),
+                        slice(dj_max - dj, dj_max - dj + pw),
+                        slice(None),
+                    )
+                    acc = acc + (
+                        share_p[sl] * (xph == y_p[sl])
+                    ).astype(jnp.float32)
+            phases.append(acc.astype(x.dtype))
+
+    # interleave phases back: [sh*sw, n, ph, pw, c] ->
+    # [n, ph, sh, pw, sw, c] -> [n, hp, wp, c]
+    dxp = (
+        jnp.stack(phases)
+        .reshape(sh, sw, n, ph, pw, c)
+        .transpose(2, 3, 0, 4, 1, 5)
+        .reshape(n, hp, wp, c)
+    )
+    dx = dxp[:, pt:pt + h, pl:pl + w, :]
+    return (dx.astype(x.dtype),)
+
+
+maxpool_tiesplit.defvjp(_maxpool_ts_fwd, _maxpool_ts_bwd)
+
+
 class Pool(Layer):
-    """Max/avg pooling via ``lax.reduce_window`` (reference: ``Pool``)."""
+    """Max/avg pooling via ``lax.reduce_window`` (reference: ``Pool``).
+
+    ``TM_POOL_BWD=tiesplit`` swaps the max-pool backward for the
+    scatter-free tie-split formulation (``maxpool_tiesplit``) —
+    measured SLOWER than select_and_scatter on v5e, see its
+    docstring; default stays exact."""
 
     def __init__(
         self,
@@ -229,6 +386,11 @@ class Pool(Layer):
         dims = (1, *self.size, 1)
         strides = (1, *self.stride, 1)
         if self.mode == "max":
+            if os.environ.get("TM_POOL_BWD") == "tiesplit":
+                return (
+                    maxpool_tiesplit(x, self.size, self.stride, self.pad),
+                    state,
+                )
             y = lax.reduce_window(
                 x, -jnp.inf, lax.max, dims, strides, self.pad
             )
