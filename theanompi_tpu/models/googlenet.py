@@ -203,6 +203,12 @@ class _GoogLeNetNet(Layer):
 
 
 class GoogLeNet(ClassifierModel):
+    """``fused_inception`` (default True) selects the fused-1x1
+    Inception modules — same math, different param-tree structure, so
+    checkpoints taken under one setting must be restored under the
+    same setting (``fused_inception: false`` resumes pre-fusion
+    checkpoints)."""
+
     AUX_WEIGHT = 0.3
 
     def __init__(self, config: dict | None = None):

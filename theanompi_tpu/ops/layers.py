@@ -199,14 +199,14 @@ class Conv(Layer):
         return y, state
 
 
-def _pool_explicit_pad(h, w, kh, kw, sh, sw, pad, oh, ow):
+def _pool_explicit_pad(shape, size, stride, pad):
     """Explicit (top, bottom), (left, right) padding matching
-    lax.reduce_window's 'SAME'/'VALID' conventions."""
-    if pad == "VALID":
-        return (0, 0), (0, 0)
-    th = max((oh - 1) * sh + kh - h, 0)
-    tw = max((ow - 1) * sw + kw - w, 0)
-    return (th // 2, th - th // 2), (tw // 2, tw - tw // 2)
+    lax.reduce_window's 'SAME'/'VALID' conventions (the library's
+    own convention resolver, sliced to the spatial dims)."""
+    pads = lax.padtype_to_pads(
+        shape, (1, *size, 1), (1, *stride, 1), pad
+    )
+    return pads[1], pads[2]
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
@@ -264,9 +264,7 @@ def _maxpool_ts_bwd(size, stride, pad, res, dy):
     sh, sw = stride
     n, h, w, c = x.shape
     oh, ow = y.shape[1], y.shape[2]
-    (pt, pb), (pl, pr) = _pool_explicit_pad(
-        h, w, kh, kw, sh, sw, pad, oh, ow
-    )
+    (pt, pb), (pl, pr) = _pool_explicit_pad(x.shape, size, stride, pad)
     # pad so every phase has the same grid size (extra sliced off)
     hp = -(-(h + pt + pb) // sh) * sh
     wp = -(-(w + pl + pr) // sw) * sw
