@@ -222,6 +222,61 @@ def bench_llama(moe: bool = False) -> dict:
     }
 
 
+def bench_lstm() -> dict:
+    """BASELINE config 4's model: IMDB LSTM training sequences/sec on
+    the contract path (focused ``TM_BENCH_MODEL=lstm`` run; first
+    captured r4, no baseline key).  The reference recipe's shape
+    (maxlen 100, emb/hidden 128) at a TPU-sensible batch; the
+    recurrence is a ``lax.scan`` whose per-step matmuls are tiny, so
+    the chunked device-resident dispatch (the same path every
+    classifier benches) is what keeps the host out of the loop."""
+    from theanompi_tpu.models.lstm import LSTM
+    from theanompi_tpu.parallel import default_devices, make_mesh
+    from theanompi_tpu.utils import Recorder, enable_compile_cache
+
+    enable_compile_cache()
+    devices = default_devices()
+    n_chips = len(devices)
+    batch = 256
+    nb = 40
+    cfg = dict(
+        batch_size=batch, maxlen=100, vocab=10000,
+        emb_dim=128, hidden=128,
+        n_train=nb * batch * n_chips, n_val=batch * n_chips,
+        device_data_cache=True, steps_per_call=nb,
+    )
+    model = LSTM(cfg)
+    model.build_model(n_replicas=n_chips)
+    model.compile_iter_fns(
+        mesh=make_mesh(data=n_chips, devices=devices),
+        exch_strategy="ici32",
+    )
+    rec = Recorder(verbose=False)
+    run_steps = _chunked_runner(model, rec, nb)
+    run_steps(model.preferred_chunk(nb))  # compile
+    rec.flush()
+
+    rates = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        done = run_steps(nb)
+        rec.flush()
+        rates.append(done * batch * n_chips / (time.perf_counter() - t0))
+    seqs_per_sec = sorted(rates)[1]
+    return {
+        "metric": (
+            f"IMDB LSTM sequences/sec/chip (BSP, b{batch}, "
+            f"maxlen {cfg['maxlen']}, h{cfg['hidden']})"
+        ),
+        "value": round(seqs_per_sec / n_chips, 2),
+        "unit": "sequences/sec/chip",
+        "vs_baseline": None,
+        "tokens_per_sec_per_chip": round(
+            seqs_per_sec * cfg["maxlen"] / n_chips, 1
+        ),
+    }
+
+
 def bench_loader() -> dict:
     """Input-pipeline metric: C++ .tmb loader throughput — read +
     crop/flip/mean-subtract + ordered delivery (SURVEY §7 hard part;
@@ -536,6 +591,7 @@ BENCHES = {
     "googlenet": lambda **kw: bench_classifier("googlenet", **kw),
     "llama": lambda **kw: bench_llama(),
     "moe": lambda **kw: bench_llama(moe=True),
+    "lstm": lambda **kw: bench_lstm(),
     "loader": lambda **kw: bench_loader(),
     "loader_train": lambda **kw: bench_loader_train(),
 }

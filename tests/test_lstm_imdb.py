@@ -189,3 +189,37 @@ class TestLSTMModel:
         tail = curve[-5:]
         assert max(tail) - min(tail) < 0.08, curve
         assert max(tail) < best + 0.08, curve
+
+
+class TestDeviceCache:
+    def test_cache_scan_matches_per_step(self):
+        """ImdbData now feeds the HBM-resident K-step scan path
+        (dataset_arrays + epoch_permutation): same math as per-step
+        host staging, batch indexing included (BASELINE config 4's
+        bench rides this path)."""
+        import jax
+
+        from theanompi_tpu.models.lstm import LSTM
+        from theanompi_tpu.parallel import make_mesh
+        from theanompi_tpu.utils import Recorder
+
+        mesh = make_mesh(data=1, devices=jax.devices("cpu")[:1])
+        cfg = dict(
+            batch_size=8, maxlen=32, vocab=200, emb_dim=16, hidden=16,
+            n_train=32, n_val=16, dropout=0.0, optimizer="sgd", lr=0.2,
+        )
+        m1 = LSTM(dict(cfg))
+        m1.build_model(n_replicas=1)
+        m1.compile_iter_fns(mesh=mesh)
+        m2 = LSTM(dict(cfg, device_data_cache=True, steps_per_call=4))
+        m2.build_model(n_replicas=1)
+        m2.compile_iter_fns(mesh=mesh)
+        r1, r2 = Recorder(rank=0), Recorder(rank=0)
+        for i in range(4):
+            m1.train_iter(i, r1)
+        m2.train_chunk(0, 4, r2)
+        r1.flush()
+        r2.flush()
+        np.testing.assert_allclose(
+            r1.train_losses, r2.train_losses, rtol=1e-4
+        )

@@ -135,6 +135,17 @@ class ImdbData:
         rng = np.random.default_rng(self._seed + epoch)
         self._perm = rng.permutation(len(self._train_y))
 
+    def dataset_arrays(self, split: str = "train"):
+        """Full (x, y) arrays for HBM-resident caching
+        (``device_data_cache`` model knob) — the whole padded token
+        set is [n, maxlen] int32, trivially HBM-sized."""
+        if split == "train":
+            return self._train_x, self._train_y
+        return self._val_x, self._val_y
+
+    def epoch_permutation(self):
+        return self._perm
+
     def train_batch(self, i: int):
         sel = self._perm[i * self.global_batch : (i + 1) * self.global_batch]
         return self._train_x[sel], self._train_y[sel]
