@@ -130,7 +130,7 @@ def _vs_baseline(key_name: str, value: float):
     return None
 
 
-def bench_llama(moe: bool = False) -> dict:
+def bench_llama(moe: bool = False, long: bool = False) -> dict:
     """Decoder-LM training tokens/sec/chip with the fused
     flash-attention kernels (baseline key Llama_tokens_per_sec_per_chip).
 
@@ -138,7 +138,12 @@ def bench_llama(moe: bool = False) -> dict:
     geometry with the FFN as a top-2 MoE over 8 experts of HALF the
     dense width — the same ACTIVE FFN FLOPs per token as the dense
     proxy, so the throughput delta vs the llama entry is the measured
-    cost of routing + dispatch (no baseline key; first captured r4)."""
+    cost of routing + dispatch (no baseline key; first captured r4).
+
+    ``long=True`` (``TM_BENCH_MODEL=llama_long``): T=8192 at b1 —
+    the long-context single-chip datapoint (full per-layer remat; the
+    remat_save A/B at this length still favors full remat, 33.8k vs
+    32.2k tok/s measured)."""
     from theanompi_tpu.models.llama import Llama
     from theanompi_tpu.parallel import default_devices, make_mesh
     from theanompi_tpu.utils import Recorder, enable_compile_cache
@@ -160,6 +165,10 @@ def bench_llama(moe: bool = False) -> dict:
         cfg.update(
             ffn_dim=1408, n_experts=8, moe_top_k=2,
             capacity_factor=1.25,
+        )
+    if long:
+        cfg.update(
+            seq_len=8192, batch_size=1, n_train=20 * 1 * n_chips,
         )
     model = Llama(cfg)
     model.build_model(n_replicas=n_chips)
@@ -215,7 +224,7 @@ def bench_llama(moe: bool = False) -> dict:
         "value": round(per_chip, 2),
         "unit": "tokens/sec/chip",
         "vs_baseline": (
-            None if moe else
+            None if (moe or long) else
             _vs_baseline("Llama_tokens_per_sec_per_chip", per_chip)
         ),
         **extra,
@@ -591,6 +600,7 @@ BENCHES = {
     "googlenet": lambda **kw: bench_classifier("googlenet", **kw),
     "llama": lambda **kw: bench_llama(),
     "moe": lambda **kw: bench_llama(moe=True),
+    "llama_long": lambda **kw: bench_llama(long=True),
     "lstm": lambda **kw: bench_lstm(),
     "loader": lambda **kw: bench_loader(),
     "loader_train": lambda **kw: bench_loader_train(),
