@@ -226,6 +226,36 @@ class TestExpertParallelLayouts:
         assert losses[-1] < losses[0] * 1.5
 
     @pytest.mark.slow
+    @pytest.mark.parametrize("lay", [
+        # interactions not individually enumerated elsewhere:
+        dict(ep=2, sp=2, sp_mode="ulysses"),        # MoE x Ulysses
+        dict(ep=2, tp=2, xent_chunks=4),            # MoE x chunked head
+        dict(ep=2, tp=2, pp=2),                     # MoE x TP x PP
+        dict(pp=2, pp_microbatches=4),              # MoE x M=4 GPipe
+    ])
+    def test_first_step_loss_invariant_cross_combos(self, devices8, lay):
+        """Layout fuzz across knob COMBINATIONS: any mix of
+        ep/tp/sp/pp/sp_mode/head/microbatch knobs must reproduce the
+        1x1 first-step loss — the blanket form of the pairwise
+        invariance tests (MoE aux moments, scattered heads, and the
+        chunked head all have to compose)."""
+        # global batch 4; heads widened so ulysses divides
+        base = dict(n_heads=8, n_kv_heads=4, optimizer="sgd", lr=0.5)
+        m1 = build_moe(devices8, data=1, **base)
+        n_rep = lay.get("data", 1) * lay.get("ep", 1)
+        m2 = build_moe(
+            devices8, batch_size=4 // n_rep, **base, **lay
+        )
+        r1, r2 = Recorder(rank=0), Recorder(rank=0)
+        m1.train_iter(0, r1)
+        m2.train_iter(0, r2)
+        r1.flush()
+        r2.flush()
+        np.testing.assert_allclose(
+            r1.train_losses, r2.train_losses, rtol=1e-4, err_msg=str(lay)
+        )
+
+    @pytest.mark.slow
     def test_first_step_loss_matches_5axis_16dev(self, devices16):
         """The maximal composition — ep=2 x tp=2 x sp=2 x pp=2 in one
         16-device mesh (MoE all_to_all + TP psums + ring SP inside
