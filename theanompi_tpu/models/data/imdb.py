@@ -139,6 +139,10 @@ class ImdbData:
         """Full (x, y) arrays for HBM-resident caching
         (``device_data_cache`` model knob) — the whole padded token
         set is [n, maxlen] int32, trivially HBM-sized."""
+        if split not in ("train", "val"):
+            raise ValueError(
+                f"unknown split {split!r} (expected 'train' or 'val')"
+            )
         if split == "train":
             return self._train_x, self._train_y
         return self._val_x, self._val_y

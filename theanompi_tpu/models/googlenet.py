@@ -238,6 +238,24 @@ class GoogLeNet(ClassifierModel):
         )
         self._init_params()
 
+    def load(self, directory, recorder=None):
+        """Checkpoint restore with a structure guard: the param tree
+        depends on ``fused_inception`` (fused modules hold one merged
+        1x1 weight where unfused hold three), so a mismatch surfaces
+        here as a missing/mis-shaped leaf — name the knob instead of
+        leaving the user to diagnose the raw tree error."""
+        try:
+            return super().load(directory, recorder)
+        except (KeyError, ValueError) as e:
+            raise RuntimeError(
+                f"checkpoint restore failed: {e}\n"
+                f"GoogLeNet's param-tree structure depends on the "
+                f"'fused_inception' config knob (currently "
+                f"{bool(self.config.get('fused_inception', True))}); a "
+                f"checkpoint saved under the other setting must be "
+                f"restored with that same setting."
+            ) from e
+
     # aux-classifier loss (train mode returns a 3-tuple)
     def primary_logits(self, out):
         return out[0] if isinstance(out, tuple) else out

@@ -145,6 +145,10 @@ class Llama(TMModel):
         self.ep = int(c.get("ep", 1))
         self.moe_aux_coef = float(c.get("moe_aux_coef", 0.01))
         self.moe_z_coef = float(c.get("moe_z_coef", 0.0))
+        # token-sharding axes for MoE aux-moment globalization; set
+        # for real in compile_iter_fns — initialized here so tracing
+        # _forward before compile agrees with loss_and_err's fallback
+        self._dp_axes = (DATA_AXIS,)
         batch = int(c.get("batch_size", 8))
         # default microbatch count: 2 per stage halves the GPipe bubble
         # vs M=S, when the local batch allows it
@@ -554,7 +558,7 @@ class Llama(TMModel):
         err = tp_lib.sharded_top1_err(logits_loc, targets, self.vocab)
         # average over the data/seq shards (each computed a local mean);
         # with pp, keep only the last stage's value first
-        dp = getattr(self, "_dp_axes", (DATA_AXIS,))
+        dp = self._dp_axes
         loss = lax.pmean(self._pp_value(loss), (*dp, SEQ_AXIS))
         err = lax.pmean(self._pp_value(err), (*dp, SEQ_AXIS))
         if not top5:

@@ -351,10 +351,13 @@ maxpool_tiesplit.defvjp(_maxpool_ts_fwd, _maxpool_ts_bwd)
 class Pool(Layer):
     """Max/avg pooling via ``lax.reduce_window`` (reference: ``Pool``).
 
-    ``TM_POOL_BWD=tiesplit`` swaps the max-pool backward for the
+    ``bwd="tiesplit"`` swaps the max-pool backward for the
     scatter-free tie-split formulation (``maxpool_tiesplit``) —
     measured SLOWER than select_and_scatter on v5e, see its
-    docstring; default stays exact."""
+    docstring; default stays exact.  ``TM_POOL_BWD`` supplies the
+    construction-time default only — it is captured when the layer is
+    BUILT, so flipping the env after a model is jitted has no effect,
+    and two pools in one process can differ via the constructor."""
 
     def __init__(
         self,
@@ -362,7 +365,16 @@ class Pool(Layer):
         stride: int | tuple[int, int] | None = None,
         mode: str = "max",
         pad: str = "VALID",
+        bwd: str | None = None,
     ):
+        self.bwd = (
+            bwd if bwd is not None else os.environ.get("TM_POOL_BWD", "")
+        )
+        if self.bwd not in ("", "tiesplit"):
+            raise ValueError(
+                f"unknown Pool bwd {self.bwd!r} (expected '' or "
+                f"'tiesplit')"
+            )
         self.size = (size, size) if isinstance(size, int) else size
         stride = stride if stride is not None else size
         self.stride = (stride, stride) if isinstance(stride, int) else stride
@@ -384,7 +396,7 @@ class Pool(Layer):
         dims = (1, *self.size, 1)
         strides = (1, *self.stride, 1)
         if self.mode == "max":
-            if os.environ.get("TM_POOL_BWD") == "tiesplit":
+            if self.bwd == "tiesplit":
                 return (
                     maxpool_tiesplit(x, self.size, self.stride, self.pad),
                     state,

@@ -50,10 +50,15 @@ from theanompi_tpu.parallel.mesh import EXPERT_AXIS, MODEL_AXIS
 def moe_capacity(
     n_tokens: int, n_experts: int, top_k: int, capacity_factor: float
 ) -> int:
-    """Static per-expert capacity for ``n_tokens`` local tokens."""
+    """Static per-expert capacity for ``n_tokens`` local tokens.
+
+    Always a multiple of 8 (TPU sublane): the ``n_tokens`` clamp
+    rounds UP to the next multiple, so a capacity near the token
+    count may slightly exceed it — harmless (extra slots stay
+    unfilled; zero-drop guarantees only need C >= N)."""
     c = int(-(-capacity_factor * top_k * n_tokens // n_experts))
     c = -(-c // 8) * 8  # sublane-align the buffer's token dim
-    return max(8, min(c, n_tokens))
+    return max(8, min(c, -(-n_tokens // 8) * 8))
 
 
 def router_topk(x2, w_router, top_k: int, renormalize: bool = True):
