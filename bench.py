@@ -74,15 +74,22 @@ def _step_flops(model, n_devices: int) -> float | None:
         return None
 
 
-def _trace_comm(run_fn, extra: dict) -> None:
+def _trace_comm(run_fn, extra: dict, n_chips: int = 1) -> None:
     """Profiler-trace comm attribution (SURVEY §5.1): capture a short
     trace AFTER the timed loop and report the overlap-aware exposed
     collective fraction — the only honest comm/calc split when the
     exchange is fused into the jitted step.  Skipped cleanly when the
-    platform yields no device op timeline (TM_BENCH_COMM=0 disables)."""
+    platform yields no device op timeline (TM_BENCH_COMM=0 disables).
+
+    On a SINGLE chip the fraction is structurally zero — there is no
+    collective to expose — so the field is emitted as ``null`` rather
+    than a vacuous 0.0 riding next to MFU (VERDICT r4 weak #5)."""
     import os
 
     if os.environ.get("TM_BENCH_COMM", "1") != "1":
+        return
+    if n_chips < 2:
+        extra["exposed_comm_frac"] = None  # single-chip: no collective
         return
     try:
         from theanompi_tpu.utils.trace_comm import report_of
@@ -95,6 +102,21 @@ def _trace_comm(run_fn, extra: dict) -> None:
             extra["comm_frac"] = round(rep["comm_frac"], 4)
     except Exception:
         pass  # attribution is diagnostic, never a bench failure
+
+
+def _window_stats(rates: list[float]) -> dict:
+    """Variance protocol for <4%-level claims (VERDICT r4 weak #2):
+    every windowed capture reports its median AND its spread, so a
+    lever win smaller than the same-invocation spread is visibly
+    inside the noise.  ``spread`` is (max-min)/median of the windows;
+    cross-invocation tunnel drift is larger (±4% observed) — levers
+    below the spread need a profiler device-time delta instead."""
+    med = sorted(rates)[len(rates) // 2]
+    return {
+        "n_windows": len(rates),
+        "spread": round((max(rates) - min(rates)) / med, 4) if med else None,
+        "windows": [round(r, 1) for r in rates],
+    }
 
 
 def _chunked_runner(model, rec, nb: int):
@@ -130,7 +152,8 @@ def _vs_baseline(key_name: str, value: float):
     return None
 
 
-def bench_llama(moe: bool = False, long: bool = False) -> dict:
+def bench_llama(moe: bool = False, long: bool = False,
+                hd128: bool = False) -> dict:
     """Decoder-LM training tokens/sec/chip with the fused
     flash-attention kernels (baseline key Llama_tokens_per_sec_per_chip).
 
@@ -143,7 +166,16 @@ def bench_llama(moe: bool = False, long: bool = False) -> dict:
     ``long=True`` (``TM_BENCH_MODEL=llama_long``): T=8192 at b1 —
     the long-context single-chip datapoint (full per-layer remat; the
     remat_save A/B at this length still favors full remat, 33.8k vs
-    32.2k tok/s measured)."""
+    32.2k tok/s measured).
+
+    ``hd128=True`` (``TM_BENCH_MODEL=llama_hd128``): the 8B ATTENTION
+    GEOMETRY at proxy depth — head_dim=128 (8 heads x 1024d) with GQA
+    4:1 (2 KV heads), everything else identical to the dense proxy.
+    Exists to test the PERFORMANCE.md ceiling claim that the proxy's
+    head_dim=64 half-fills the MXU's 128-wide contraction and the
+    real 8B shape would not (VERDICT r4 missing #3): if MFU moves
+    materially above the ~35% dense-proxy capture, the geometry
+    argument holds; if not, the limiter is elsewhere."""
     from theanompi_tpu.models.llama import Llama
     from theanompi_tpu.parallel import default_devices, make_mesh
     from theanompi_tpu.utils import Recorder, enable_compile_cache
@@ -170,6 +202,8 @@ def bench_llama(moe: bool = False, long: bool = False) -> dict:
         cfg.update(
             seq_len=8192, batch_size=1, n_train=20 * 1 * n_chips,
         )
+    if hd128:
+        cfg.update(n_heads=8, n_kv_heads=2)
     model = Llama(cfg)
     model.build_model(n_replicas=n_chips)
     model.compile_iter_fns(mesh=make_mesh(data=n_chips, devices=devices))
@@ -195,14 +229,14 @@ def bench_llama(moe: bool = False, long: bool = False) -> dict:
     tokens_per_sec = sorted(rates)[1]
     per_chip = tokens_per_sec / n_chips
 
-    extra = {}
+    extra = _window_stats([r / n_chips for r in rates])
 
     def _traced_chunk():
         # trace the SAME executable the timed loop ran (already warm)
         run_steps(model.preferred_chunk(nb))
         rec.flush()
 
-    _trace_comm(_traced_chunk, extra)
+    _trace_comm(_traced_chunk, extra, n_chips)
     peak = _peak_flops(devices)
     flops = _step_flops(model, n_chips)
     if flops and peak:
@@ -215,6 +249,8 @@ def bench_llama(moe: bool = False, long: bool = False) -> dict:
     name = (
         f"Llama-{cfg['n_layers']}L-{cfg['dim']}d"
         + (f"-MoE-E{cfg['n_experts']}top{cfg['moe_top_k']}" if moe else "")
+        + (f"-hd128-gqa{cfg['n_heads'] // cfg['n_kv_heads']}"
+           if hd128 else "")
     )
     return {
         "metric": (
@@ -224,7 +260,7 @@ def bench_llama(moe: bool = False, long: bool = False) -> dict:
         "value": round(per_chip, 2),
         "unit": "tokens/sec/chip",
         "vs_baseline": (
-            None if (moe or long) else
+            None if (moe or long or hd128) else
             _vs_baseline("Llama_tokens_per_sec_per_chip", per_chip)
         ),
         **extra,
@@ -283,13 +319,23 @@ def bench_lstm() -> dict:
         "tokens_per_sec_per_chip": round(
             seqs_per_sec * cfg["maxlen"] / n_chips, 1
         ),
+        **_window_stats([r / n_chips for r in rates]),
     }
 
 
 def bench_loader() -> dict:
     """Input-pipeline metric: C++ .tmb loader throughput — read +
     crop/flip/mean-subtract + ordered delivery (SURVEY §7 hard part;
-    baseline key Loader_images_per_sec)."""
+    baseline key Loader_images_per_sec).
+
+    Contention guard (VERDICT r4 weak #6: captures ranged 1405-1560
+    idle vs 472 under host load on this 1-core host): the epoch sweep
+    runs 3 windows — plus up to 2 retry windows when the spread says a
+    window was contended — and reports the MEDIAN (same protocol as
+    the round-1 baseline capture and every other row; best-of-N would
+    inflate vs_baseline by protocol change alone), with all windows +
+    the host 1-min loadavg in the row so a depressed capture is
+    visible instead of silently becoming the number of record."""
     import os
     import tempfile
 
@@ -321,13 +367,27 @@ def bench_loader() -> dict:
         )
         L.set_epoch(0)
         L.next()  # warm the pool
-        L.set_epoch(1)
-        t0 = time.perf_counter()
-        for _ in range(n_files):
-            L.next()
-        dt = time.perf_counter() - t0
+        rates = []
+        epoch = 1
+        while len(rates) < 3 or (
+            # contended window detected: widen the sample (max 5)
+            len(rates) < 5
+            and (max(rates) - min(rates)) / max(rates) > 0.15
+        ):
+            L.set_epoch(epoch)
+            epoch += 1
+            t0 = time.perf_counter()
+            for _ in range(n_files):
+                L.next()
+            rates.append(n_files * batch / (time.perf_counter() - t0))
         L.close()
-    per_sec = n_files * batch / dt
+    stats = _window_stats(rates)
+    per_sec = sorted(rates)[len(rates) // 2]
+    getloadavg = getattr(os, "getloadavg", None)
+    try:
+        loadavg = round(getloadavg()[0], 2) if getloadavg else None
+    except OSError:  # pragma: no cover - platform quirk
+        loadavg = None
     return {
         "metric": (
             f"native .tmb loader images/sec ({n_threads} threads, "
@@ -336,6 +396,8 @@ def bench_loader() -> dict:
         "value": round(per_sec, 2),
         "unit": "images/sec",
         "vs_baseline": _vs_baseline("Loader_images_per_sec", per_sec),
+        **stats,
+        "loadavg_1m": loadavg,
     }
 
 
@@ -433,11 +495,183 @@ def bench_loader_train() -> dict:
                     "images_per_sec": round(rep["images_per_sec"], 1),
                     "calc_s": round(rep["calc_s"], 2),
                     "wait_s": round(rep["wait_s"], 3),
+                    "scale_note": (
+                        "XLA:CPU consumption rate (~2 img/s) — "
+                        "prefetch/overlap mechanics are "
+                        "link-independent but this row has never "
+                        "been exercised at TPU-rate consumption "
+                        "(tunneled host<->device link moves ~30 MB/s)"
+                    ),
                 }
         raise RuntimeError(
             f"loader_train child produced no result:\n"
             f"{out.stdout[-1500:]}\n{out.stderr[-1500:]}"
         )
+
+
+def bench_easgd() -> dict:
+    """BASELINE config 3: WRN-28-10 under the EASGD rule's exchange
+    cadence, on the real chip — the async rules' first captured COST
+    datum (VERDICT r4 missing #2: their correctness was well-tested,
+    their price never measured).
+
+    Protocol: one worker replica on the chip (ReplicaEngine local
+    step) + an on-chip center copy, the elastic merge jitted with
+    donation — the production shape when replicas share a pod slice
+    over ICI.  Throughput at exchange cadence tau in {1, 4, 16} vs the
+    same-invocation no-exchange rate, so the overhead attribution is
+    immune to host/tunnel drift; the merge event is also timed
+    directly (back-to-back, fenced).  Batches are PRE-STAGED device
+    arrays: the worker's per-step ``put_batch`` host transfer would
+    measure this image's ~30 MB/s tunnel, not the rule (a production
+    host's PCIe moves a b256 CIFAR batch in well under 1 ms).  The
+    merge cost does not depend on alpha; 0.5 is used so the pair
+    update is non-degenerate at W=1."""
+    import jax
+
+    from theanompi_tpu.models.wresnet import WResNet
+    from theanompi_tpu.parallel import (
+        default_devices,
+        elastic_center_merge,
+        make_mesh,
+    )
+    from theanompi_tpu.utils import enable_compile_cache
+    from theanompi_tpu.workers.replica_engine import ReplicaEngine
+
+    enable_compile_cache()
+    devices = default_devices()
+    n_chips = len(devices)
+    mesh = make_mesh(data=n_chips, devices=devices)
+    batch = 256
+    cfg = {
+        "batch_size": batch, "depth": 28, "widen": 10,
+        "n_train": 4 * batch * n_chips, "n_val": batch * n_chips,
+    }
+    model = WResNet(cfg)
+    model.build_model(n_replicas=n_chips)
+    engine = ReplicaEngine(model, mesh)
+    batches = [
+        engine.put_batch(model.data.train_batch(i)) for i in range(4)
+    ]
+    center = jax.device_put(model.params, engine.replicated)
+    exchange = jax.jit(elastic_center_merge, donate_argnums=(0, 1))
+    alpha = 0.5
+
+    def run_window(n_steps: int, tau: int | None):
+        nonlocal center
+        loss = None
+        for i in range(n_steps):
+            loss, _ = engine.train_step_staged(
+                batches[i % len(batches)], model.current_lr
+            )
+            if tau and (i + 1) % tau == 0:
+                engine.params, center = exchange(
+                    engine.params, center, alpha
+                )
+        jax.block_until_ready(loss)  # fence: one value read per window
+
+    run_window(2, 1)  # compile both executables
+    jax.block_until_ready(jax.tree.leaves(center)[0])
+
+    n_steps = 32
+    rates: dict[str, float] = {}
+    spreads: dict[str, float] = {}
+    for label, tau in (
+        ("no_exchange", None), ("tau1", 1), ("tau4", 4), ("tau16", 16),
+    ):
+        window_rates = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            run_window(n_steps, tau)
+            window_rates.append(
+                n_steps * batch * n_chips / (time.perf_counter() - t0)
+            )
+        stats = _window_stats(window_rates)
+        rates[label] = round(sorted(window_rates)[1] / n_chips, 2)
+        spreads[label] = stats["spread"]
+
+    # the merge event itself, fenced back-to-back
+    n_ex = 20
+    t0 = time.perf_counter()
+    for _ in range(n_ex):
+        engine.params, center = exchange(engine.params, center, alpha)
+    jax.block_until_ready(jax.tree.leaves(center)[0])
+    exchange_ms = (time.perf_counter() - t0) / n_ex * 1e3
+
+    base = rates["no_exchange"]
+    return {
+        "metric": (
+            f"WRN-28-10 EASGD images/sec/chip vs exchange cadence "
+            f"(b{batch}, 1 replica/chip, on-chip center, alpha=0.5)"
+        ),
+        "value": rates["tau4"],  # the rule's default cadence
+        "unit": "images/sec/chip",
+        "vs_baseline": None,
+        "tau_rates": rates,
+        "tau_spreads": spreads,
+        "exchange_ms": round(exchange_ms, 3),
+        "overhead_frac": {
+            k: round(1.0 - v / base, 4)
+            for k, v in rates.items() if k != "no_exchange"
+        },
+    }
+
+
+def bench_gosgd() -> dict:
+    """GoSGD round cost at WRN-28-10 parameter scale (VERDICT r4
+    missing #2's second half).  Measures the jitted
+    ``gossip_matrix_round`` merge — the score-weighted routing-matrix
+    contraction every push delivers through — with W=2 replica slots
+    resident on ONE chip: the merge's HBM traffic is what a pod
+    replica pays per received push; no inter-chip wire is crossed
+    here and the row says so.  Per-step expected cost = p x round
+    (each worker pushes with probability p per iteration)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from theanompi_tpu.models.wresnet import WResNet
+    from theanompi_tpu.parallel import gossip_matrix_round
+    from theanompi_tpu.utils import enable_compile_cache
+    from theanompi_tpu.workers.replica_engine import broadcast_stack
+
+    enable_compile_cache()
+    w = 2
+    model = WResNet({
+        "batch_size": 32, "depth": 28, "widen": 10,
+        "n_train": 64, "n_val": 32,
+    })
+    model.build_model(n_replicas=1)
+    stacked = {"params": broadcast_stack(model.params, w)}
+    scores = jnp.full((w,), 1.0 / w, jnp.float32)
+    route = jnp.asarray(
+        np.array([1, 0]), jnp.int32
+    )  # each pushes to the other
+    push = jnp.ones((w,), jnp.float32)
+    round_fn = jax.jit(gossip_matrix_round)
+
+    stacked, scores = round_fn(stacked, scores, route, push)  # compile
+    jax.block_until_ready(scores)
+    n_rounds = 20
+    t0 = time.perf_counter()
+    for _ in range(n_rounds):
+        stacked, scores = round_fn(stacked, scores, route, push)
+    jax.block_until_ready(scores)
+    round_ms = (time.perf_counter() - t0) / n_rounds * 1e3
+    n_params = sum(
+        int(np.prod(np.shape(x))) for x in jax.tree.leaves(model.params)
+    )
+    return {
+        "metric": (
+            "GoSGD gossip round ms (WRN-28-10 params, W=2 slots on "
+            "one chip; merge compute/HBM only, no inter-chip wire)"
+        ),
+        "value": round(round_ms, 3),
+        "unit": "ms/round",
+        "vs_baseline": None,
+        "n_params": n_params,
+    }
 
 
 def build_classifier(which: str, batch: int | None = None,
@@ -551,7 +785,7 @@ def bench_classifier(which: str, with_comm: bool = True) -> dict:
     global_batch = batch * n_chips
     per_chip = images_per_sec / n_chips
 
-    extra = {}
+    extra = _window_stats([r / n_chips for r in rates])
 
     def _traced_chunk():
         run_steps(model.preferred_chunk(nb))
@@ -559,7 +793,7 @@ def bench_classifier(which: str, with_comm: bool = True) -> dict:
         # otherwise leave the device ops outside the capture window
 
     if with_comm:
-        _trace_comm(_traced_chunk, extra)
+        _trace_comm(_traced_chunk, extra, n_chips)
     peak = _peak_flops(devices)
     flops = _step_flops(model, n_chips)
     if flops is None:
@@ -601,9 +835,12 @@ BENCHES = {
     "llama": lambda **kw: bench_llama(),
     "moe": lambda **kw: bench_llama(moe=True),
     "llama_long": lambda **kw: bench_llama(long=True),
+    "llama_hd128": lambda **kw: bench_llama(hd128=True),
     "lstm": lambda **kw: bench_lstm(),
     "loader": lambda **kw: bench_loader(),
     "loader_train": lambda **kw: bench_loader_train(),
+    "easgd": lambda **kw: bench_easgd(),
+    "gosgd": lambda **kw: bench_gosgd(),
 }
 
 
@@ -631,7 +868,7 @@ def main() -> None:
     rec = BENCHES["resnet50"]()
     secondary = {}
     for name in ("wresnet", "llama", "alexnet", "loader",
-                 "loader_train"):
+                 "loader_train", "easgd", "gosgd"):
         # two attempts: the tunneled remote-compile service drops a
         # response now and then (observed: "response body closed
         # before all bytes were read"); a transient must not cost the

@@ -189,7 +189,17 @@ class ReplicaEngine:
         ``step_mask`` — optional ``[W]`` {0,1} array: only masked
         workers advance (heterogeneous speeds for the async rules);
         the mean is over the active workers."""
-        x, y = self.put_batch(batch)
+        return self.train_step_staged(
+            self.put_batch(batch), lr, step_mask
+        )
+
+    def train_step_staged(self, staged, lr: float, step_mask=None):
+        """``train_step`` on an ALREADY-staged ``[W, B, ...]`` device
+        batch (from ``put_batch``) — for loops that keep batches
+        device-resident (benches; pod loops reusing an HBM cache),
+        where the per-step host transfer would dominate or distort
+        the measurement."""
+        x, y = staged
         self._rng, k = jax.random.split(self._rng)
         keys = jax.random.split(k, self.n_workers)
         if step_mask is None:
