@@ -143,6 +143,20 @@ def _chunked_runner(model, rec, nb: int):
     return run_steps
 
 
+def _env_cfg_overrides() -> dict:
+    """``TM_BENCH_CFG`` JSON overlay for lever A/Bs (e.g.
+    '{"stage1_width": 128}').  Honored ONLY in focused
+    ``TM_BENCH_MODEL`` runs: a full-bench capture can never be
+    silently polluted by a leftover env var, and every row that used
+    an overlay carries it in its JSON (``cfg_overrides``)."""
+    import os
+
+    if not os.environ.get("TM_BENCH_MODEL"):
+        return {}
+    raw = os.environ.get("TM_BENCH_CFG")
+    return json.loads(raw) if raw else {}
+
+
 def _vs_baseline(key_name: str, value: float):
     baseline_path = REPO / "BENCH_BASELINE.json"
     if baseline_path.exists():
@@ -204,6 +218,11 @@ def bench_llama(moe: bool = False, long: bool = False,
         )
     if hd128:
         cfg.update(n_heads=8, n_kv_heads=2)
+    ov = _env_cfg_overrides()
+    cfg.update(ov)
+    # n_train derives from the FINAL batch size (20 whole-scan batches
+    # per epoch) so a batch/seq override keeps the accounting honest
+    cfg["n_train"] = 20 * cfg["batch_size"] * n_chips
     model = Llama(cfg)
     model.build_model(n_replicas=n_chips)
     model.compile_iter_fns(mesh=make_mesh(data=n_chips, devices=devices))
@@ -252,6 +271,8 @@ def bench_llama(moe: bool = False, long: bool = False,
         + (f"-hd128-gqa{cfg['n_heads'] // cfg['n_kv_heads']}"
            if hd128 else "")
     )
+    if ov:
+        extra["cfg_overrides"] = ov
     return {
         "metric": (
             f"{name} tokens/sec/chip "
@@ -682,6 +703,8 @@ def build_classifier(which: str, batch: int | None = None,
     the configuration the bench reports.
 
     Returns ``(model, modelclass, batch, nb)``."""
+    import os
+
     from theanompi_tpu.models import load_flagship
     from theanompi_tpu.parallel import default_devices, make_mesh
     from theanompi_tpu.utils import enable_compile_cache
@@ -722,6 +745,14 @@ def build_classifier(which: str, batch: int | None = None,
         batch = batch or def_batch
         cfg["batch_size"] = batch
         img_bytes = 224 * 224 * 3 * 2         # ImageNet-shape bf16
+    # A/B overlay BEFORE the epoch/cache sizing below: a batch_size
+    # override must flow into nb/n_train and the returned batch or
+    # the reported rate would be silently wrong
+    ov = _env_cfg_overrides()
+    if ov:
+        cfg.update(ov)
+        batch = int(cfg.get("batch_size", batch))
+        cfg["batch_size"] = batch
     # 80 batches per epoch (chunked dispatch below always runs whole
     # scans, never a ragged tail): host dispatch through a tunneled
     # runtime is still ~1ms/scan, so longer scans keep paying — 20 ->
@@ -786,6 +817,9 @@ def bench_classifier(which: str, with_comm: bool = True) -> dict:
     per_chip = images_per_sec / n_chips
 
     extra = _window_stats([r / n_chips for r in rates])
+    ov = _env_cfg_overrides()
+    if ov:
+        extra["cfg_overrides"] = ov
 
     def _traced_chunk():
         run_steps(model.preferred_chunk(nb))

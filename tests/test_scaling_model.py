@@ -3,6 +3,8 @@ items 7 and 10).  Pure shape/datasheet math — no devices, no jit."""
 
 import math
 
+import pytest
+
 from theanompi_tpu.models.llama import LLAMA3_8B
 from theanompi_tpu.utils.scaling_model import (
     V5E,
@@ -161,3 +163,53 @@ def test_moe_alltoall_bytes_and_overhead():
     )
     assert 0 < ov["frac_of_step"] < 0.2
     assert ov["efficiency_no_overlap"] > 0.8
+
+
+@pytest.mark.slow
+def test_llama8b_dress_rehearsal_tp4_pp4(devices16, tmp_path):
+    """BASELINE config 5 as an EXECUTED program (VERDICT r4 next #8):
+    ``test_llama8b_hbm_sizing`` proves tp=4 x pp=4 fits the 8B at
+    ~7.6 GB/chip; this runs a real training step of a
+    dimension-scaled model carrying the true 8B RATIOS — head_dim=128
+    (16 heads x 2048d), GQA 4:1 (4 KV heads), ffn/dim = 3.5,
+    vocab-sharded head — on the 16-device virtual mesh at EXACTLY
+    that layout (model=4, pipe=4), then round-trips a sharded
+    checkpoint at the same layout."""
+    import numpy as np
+
+    import jax
+
+    from theanompi_tpu.models.llama import Llama
+    from theanompi_tpu.parallel import make_mesh
+    from theanompi_tpu.utils import Recorder
+
+    cfg = dict(
+        dim=2048, n_layers=4, n_heads=16, n_kv_heads=4,
+        ffn_dim=7168, vocab=2048, seq_len=64, batch_size=8,
+        tp=4, pp=4, remat=True, compute_dtype="float32",
+        lr=1e-2, n_train=16, n_val=8,
+    )
+    assert cfg["dim"] // cfg["n_heads"] == 128          # 8B head_dim
+    assert cfg["n_heads"] // cfg["n_kv_heads"] == 4     # 8B GQA ratio
+    assert cfg["ffn_dim"] / cfg["dim"] == 3.5           # 8B FFN ratio
+    mesh = make_mesh(data=1, model=4, pipe=4, devices=devices16)
+    model = Llama(cfg)
+    model.build_model(n_replicas=1)
+    model.compile_iter_fns(mesh=mesh)
+    rec = Recorder(rank=0)
+    model.train_iter(0, rec)
+    rec.flush()
+    assert rec.n_iter == 1
+    loss0 = rec.train_losses[-1]
+    assert np.isfinite(loss0) and 0.0 < loss0 < 20.0, loss0
+
+    # sharded save/restore at the SAME 16-way layout
+    model.save(str(tmp_path), rec)
+    m2 = Llama(dict(cfg, seed=model.seed + 1))  # different init
+    m2.build_model(n_replicas=1)
+    m2.compile_iter_fns(mesh=mesh)
+    assert m2.load(str(tmp_path))
+    for a, b in zip(
+        jax.tree.leaves(model.params), jax.tree.leaves(m2.params)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -56,6 +56,7 @@ class Cifar10Data:
         seed: int = 0,
         n_train: int | None = None,
         n_val: int | None = None,
+        label_noise: float = 0.0,
     ):
         self.batch_size = batch_size
         self.n_replicas = n_replicas
@@ -74,6 +75,7 @@ class Cifar10Data:
                 n_replicas,
                 n_train=n_train or 2048,
                 n_val=n_val or 512,
+                label_noise=label_noise,
                 seed=seed,
             )
             self.n_batch_train = self._syn.n_batch_train
@@ -85,6 +87,20 @@ class Cifar10Data:
             train_x, train_y = train_x[:n_train], train_y[:n_train]
         if n_val:
             val_x, val_y = val_x[:n_val], val_y[:n_val]
+        if label_noise > 0.0:
+            # same semantics as the synthetic path: a fraction of
+            # RETURNED labels resampled uniformly, images untouched
+            # (convergence drills need the noise floor on either path)
+            out = []
+            for arr, salt in ((train_y, 3), (val_y, 4)):
+                arr = arr.copy()
+                nrng = np.random.default_rng(seed + 7919 * salt)
+                flip = nrng.random(len(arr)) < label_noise
+                arr[flip] = nrng.integers(
+                    0, N_CLASSES, int(flip.sum())
+                ).astype(np.int32)
+                out.append(arr)
+            train_y, val_y = out
         mean = train_x.mean(axis=(0, 1, 2), keepdims=True)
         std = train_x.std(axis=(0, 1, 2), keepdims=True)
         self._train_x = (train_x - mean) / std

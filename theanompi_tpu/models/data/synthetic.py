@@ -22,6 +22,7 @@ class SyntheticClassData:
         n_train: int = 2048,
         n_val: int = 512,
         noise: float = 0.5,
+        label_noise: float = 0.0,
         seed: int = 0,
         dtype=np.float32,
     ):
@@ -54,6 +55,29 @@ class SyntheticClassData:
         ]
         self._train_y = rng.integers(0, n_classes, self.n_train).astype(np.int32)
         self._val_y = rng.integers(0, n_classes, self.n_val).astype(np.int32)
+        # label_noise: resample that fraction of RETURNED labels
+        # uniformly while the image keeps its ORIGINAL class's
+        # template (the clean copies below feed image generation —
+        # flipping before generation would re-template the image to
+        # the new class and produce a self-consistent, noise-free
+        # task).  Puts a floor of ~label_noise*(C-1)/C on val error,
+        # so convergence drills plateau OFF zero and 1-vs-N curve
+        # comparisons stay discriminative at the plateau (two curves
+        # stuck at 0.0 agree trivially).
+        self._train_y_clean = self._train_y
+        self._val_y_clean = self._val_y
+        self.label_noise = float(label_noise)
+        if self.label_noise > 0.0:
+            noisy = []
+            for arr, salt in ((self._train_y, 3), (self._val_y, 4)):
+                arr = arr.copy()
+                nrng = np.random.default_rng(seed + 7919 * salt)
+                flip = nrng.random(len(arr)) < self.label_noise
+                arr[flip] = nrng.integers(
+                    0, n_classes, int(flip.sum())
+                ).astype(np.int32)
+                noisy.append(arr)
+            self._train_y, self._val_y = noisy
         self._train_seed = seed + 1
         self._val_seed = seed + 2
         self._perm = np.arange(self.n_train)
@@ -95,7 +119,7 @@ class SyntheticClassData:
         chunks = []
         step = max(1, (1 << 24) // int(np.prod(self.input_shape)))
         for s in range(0, self.n_train, step):
-            ys = self._train_y[s : s + step]
+            ys = self._train_y_clean[s : s + step]  # template = clean class
             chunks.append(self._make(ys, self._train_seed * 100003 + s)[0])
         self._train_x = np.concatenate(chunks) if chunks else np.empty(
             (0, *self.input_shape), self.dtype
@@ -135,5 +159,6 @@ class SyntheticClassData:
         )
 
     def val_batch(self, i: int):
-        ys = self._val_y[i * self.global_batch : (i + 1) * self.global_batch]
-        return self._make(ys, self._val_seed * 100003 + i)
+        sl = slice(i * self.global_batch, (i + 1) * self.global_batch)
+        x, _ = self._make(self._val_y_clean[sl], self._val_seed * 100003 + i)
+        return x, self._val_y[sl]

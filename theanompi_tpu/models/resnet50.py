@@ -34,16 +34,27 @@ _EXPANSION = 4
 
 
 class Bottleneck(Layer):
-    """1x1 -> 3x3(stride) -> 1x1 bottleneck with projection shortcut."""
+    """1x1 -> 3x3(stride) -> 1x1 bottleneck with projection shortcut.
 
-    def __init__(self, ch: int, stride: int = 1):
+    ``out_ch`` decouples the block's OUTPUT width from the internal
+    width (default ``ch * 4``): the ``stage1_width`` experiment pads
+    stage-1's internal 64-channel convs to a wider MXU-filling width
+    while the residual stream stays 256 wide — with the pad slices
+    zero-initialized the function is exactly the 64-wide one
+    (asserted by ``test_model_zoo.py::test_stage1_width_pad_is_exact``;
+    the on-chip A/B measured −15.7%, so the knob is a measured
+    retirement record, not a recommended setting — see
+    docs/PERFORMANCE.md "Known ceilings")."""
+
+    def __init__(self, ch: int, stride: int = 1, out_ch: int | None = None):
         self.ch = ch
+        self.out_ch = out_ch if out_ch is not None else ch * _EXPANSION
         self.stride = stride
         self.conv1 = Conv(ch, 1, bias=False)
         self.bn1 = BN()
         self.conv2 = Conv(ch, 3, stride=stride, pad=1, bias=False)
         self.bn2 = BN()
-        self.conv3 = Conv(ch * _EXPANSION, 1, bias=False)
+        self.conv3 = Conv(self.out_ch, 1, bias=False)
         self.bn3 = BN()
         self.proj: Conv | None = None
         self.bn_proj: BN | None = None
@@ -61,7 +72,7 @@ class Bottleneck(Layer):
         p["bn3"] = dict(p["bn3"], scale=p["bn3"]["scale"] * 0.0)
         if self.stride != 1 or in_shape[-1] != out[-1]:
             self.proj = Conv(
-                self.ch * _EXPANSION, 1, stride=self.stride, bias=False
+                self.out_ch, 1, stride=self.stride, bias=False
             )
             self.bn_proj = BN()
             p["proj"], _, _ = self.proj.init(keys[6], in_shape)
@@ -104,8 +115,14 @@ class ResNet50(ClassifierModel):
         # 7x7/s2 C=3 conv starves the MXU (~14% of the step on 2.4% of
         # the FLOPs, measured fwd+bwd on v5e); the transform is exact
         # and checkpoint-compatible (ops/layers.py Conv s2d)
+        # stage1_width > 64 pads the MXU-underfilled 64-channel convs
+        # (stem + stage-1 internals) to a lane-filling width; the
+        # residual stream stays 256 so every other stage is untouched.
+        # With pad_stage1_params-style zero pads this computes exactly
+        # the standard network (test_model_zoo asserts it).
+        s1w = int(self.config.get("stage1_width", 64))
         layers: list[Layer] = [
-            Conv(64, 7, stride=2, pad=3, bias=False,
+            Conv(s1w, 7, stride=2, pad=3, bias=False,
                  w_init=initializers.he(),
                  s2d=bool(self.config.get("stem_s2d", True))),
             BN(),
@@ -115,7 +132,12 @@ class ResNet50(ClassifierModel):
         for stage, (blocks, ch) in enumerate(_STAGES):
             for b in range(blocks):
                 stride = 2 if (stage > 0 and b == 0) else 1
-                layers.append(Bottleneck(ch, stride))
+                if stage == 0:
+                    layers.append(
+                        Bottleneck(s1w, stride, out_ch=ch * _EXPANSION)
+                    )
+                else:
+                    layers.append(Bottleneck(ch, stride))
         layers += [GlobalAvgPool(), FC(N_CLASSES, w_init=initializers.normal(0.01))]
         self.net = Sequential(layers)
         crop = int(self.config.get("crop", CROP))
