@@ -233,6 +233,12 @@ def bench_llama(moe: bool = False, long: bool = False,
 
     run_steps(model.preferred_chunk(nb))  # compile
     rec.flush()
+    # second warmup scan: the FIRST post-compile scan consistently
+    # runs ~10% slow on this family (measured 68.9k then 77.3/77.35k
+    # across r5 captures — steady state from scan 2 on), which would
+    # only inflate the spread field; the median was already robust
+    run_steps(model.preferred_chunk(nb))
+    rec.flush()
 
     # median of 3 windows (tunnel jitter, see bench_classifier)
     n_steps = 20
@@ -703,7 +709,7 @@ def build_classifier(which: str, batch: int | None = None,
     the configuration the bench reports.
 
     Returns ``(model, modelclass, batch, nb)``."""
-    import os
+    requested_batch = batch
 
     from theanompi_tpu.models import load_flagship
     from theanompi_tpu.parallel import default_devices, make_mesh
@@ -747,11 +753,14 @@ def build_classifier(which: str, batch: int | None = None,
         img_bytes = 224 * 224 * 3 * 2         # ImageNet-shape bf16
     # A/B overlay BEFORE the epoch/cache sizing below: a batch_size
     # override must flow into nb/n_train and the returned batch or
-    # the reported rate would be silently wrong
+    # the reported rate would be silently wrong.  An EXPLICIT batch
+    # argument (e.g. profile_flagship --batch) outranks the overlay —
+    # a leftover env var must not silently repoint a CLI request.
     ov = _env_cfg_overrides()
     if ov:
         cfg.update(ov)
-        batch = int(cfg.get("batch_size", batch))
+        if requested_batch is None:
+            batch = int(cfg.get("batch_size", batch))
         cfg["batch_size"] = batch
     # 80 batches per epoch (chunked dispatch below always runs whole
     # scans, never a ragged tail): host dispatch through a tunneled

@@ -1,5 +1,8 @@
 """Analytical scaling predictor + 8B operational sizing (VERDICT r3
-items 7 and 10).  Pure shape/datasheet math — no devices, no jit."""
+items 7 and 10).  Mostly pure shape/datasheet math (no devices, no
+jit) — EXCEPT the slow-tier 8B dress rehearsal at the end, which
+compiles and runs a real training step on the 16-device virtual
+mesh."""
 
 import math
 

@@ -88,19 +88,18 @@ class Cifar10Data:
         if n_val:
             val_x, val_y = val_x[:n_val], val_y[:n_val]
         if label_noise > 0.0:
-            # same semantics as the synthetic path: a fraction of
-            # RETURNED labels resampled uniformly, images untouched
-            # (convergence drills need the noise floor on either path)
-            out = []
-            for arr, salt in ((train_y, 3), (val_y, 4)):
-                arr = arr.copy()
-                nrng = np.random.default_rng(seed + 7919 * salt)
-                flip = nrng.random(len(arr)) < label_noise
-                arr[flip] = nrng.integers(
-                    0, N_CLASSES, int(flip.sum())
-                ).astype(np.int32)
-                out.append(arr)
-            train_y, val_y = out
+            # same semantics as the synthetic path (shared helper): a
+            # fraction of RETURNED labels resampled uniformly, images
+            # untouched — the convergence drills need the noise floor
+            # on either path
+            from theanompi_tpu.models.data.synthetic import (
+                resample_labels,
+            )
+
+            train_y = resample_labels(
+                train_y, label_noise, N_CLASSES, seed, 3
+            )
+            val_y = resample_labels(val_y, label_noise, N_CLASSES, seed, 4)
         mean = train_x.mean(axis=(0, 1, 2), keepdims=True)
         std = train_x.std(axis=(0, 1, 2), keepdims=True)
         self._train_x = (train_x - mean) / std

@@ -12,6 +12,22 @@ from __future__ import annotations
 import numpy as np
 
 
+def resample_labels(
+    arr: np.ndarray, frac: float, n_classes: int, seed: int, salt: int
+) -> np.ndarray:
+    """Uniformly resample ``frac`` of the labels (label-noise floor
+    for the convergence drills).  Shared by the synthetic and
+    real-CIFAR paths so 'same semantics on either path' stays true by
+    construction."""
+    arr = arr.copy()
+    nrng = np.random.default_rng(seed + 7919 * salt)
+    flip = nrng.random(len(arr)) < frac
+    arr[flip] = nrng.integers(
+        0, n_classes, int(flip.sum())
+    ).astype(np.int32)
+    return arr
+
+
 class SyntheticClassData:
     def __init__(
         self,
@@ -68,16 +84,12 @@ class SyntheticClassData:
         self._val_y_clean = self._val_y
         self.label_noise = float(label_noise)
         if self.label_noise > 0.0:
-            noisy = []
-            for arr, salt in ((self._train_y, 3), (self._val_y, 4)):
-                arr = arr.copy()
-                nrng = np.random.default_rng(seed + 7919 * salt)
-                flip = nrng.random(len(arr)) < self.label_noise
-                arr[flip] = nrng.integers(
-                    0, n_classes, int(flip.sum())
-                ).astype(np.int32)
-                noisy.append(arr)
-            self._train_y, self._val_y = noisy
+            self._train_y = resample_labels(
+                self._train_y, self.label_noise, n_classes, seed, 3
+            )
+            self._val_y = resample_labels(
+                self._val_y, self.label_noise, n_classes, seed, 4
+            )
         self._train_seed = seed + 1
         self._val_seed = seed + 2
         self._perm = np.arange(self.n_train)

@@ -100,8 +100,9 @@ class WResNet(ClassifierModel):
             seed=self.seed,
             n_train=self.config.get("n_train"),
             n_val=self.config.get("n_val"),
-            # convergence drills: flip a fraction of labels so the
-            # plateau sits off the floor (synthetic data only)
+            # convergence drills: flip a fraction of returned labels
+            # so the plateau sits off the floor (applies on both the
+            # synthetic and real-CIFAR paths)
             label_noise=float(self.config.get("label_noise", 0.0)),
         )
         self._init_params()
