@@ -90,3 +90,31 @@ class TestCheckpoint:
 
     def test_empty_dir_returns_none(self, tmp_path):
         assert latest_checkpoint(tmp_path / "nope") is None
+
+
+class TestResampleLabels:
+    """Label-noise helper shared by the synthetic and real-CIFAR data
+    paths (convergence drills' noise floor — docs/PERFORMANCE.md
+    "Convergence equivalence", r5 retune)."""
+
+    def test_deterministic_and_fraction(self):
+        from theanompi_tpu.models.data.synthetic import resample_labels
+
+        y = np.random.default_rng(1).integers(0, 10, 4000).astype(np.int32)
+        y0 = y.copy()
+        a = resample_labels(y, 0.25, 10, seed=0, salt=3)
+        b = resample_labels(y, 0.25, 10, seed=0, salt=3)
+        np.testing.assert_array_equal(a, b)      # same seed+salt
+        assert (resample_labels(y, 0.25, 10, seed=0, salt=4) != a).any()
+        np.testing.assert_array_equal(y, y0)     # input untouched
+        # effective flip rate ~ frac * (C-1)/C = 0.225
+        frac = float((a != y).mean())
+        assert 0.18 < frac < 0.27, frac
+
+    def test_zero_noise_identity(self):
+        from theanompi_tpu.models.data.synthetic import resample_labels
+
+        y = np.arange(100, dtype=np.int32) % 10
+        np.testing.assert_array_equal(
+            resample_labels(y, 0.0, 10, seed=0, salt=3), y
+        )
