@@ -29,37 +29,78 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("model", nargs="?", default="resnet50",
                     choices=["resnet50", "wresnet", "alexnet",
-                             "vgg16", "googlenet"])
+                             "vgg16", "googlenet",
+                             "llama", "moe", "llama_long",
+                             "llama_hd128"])
     ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--steps", type=int, default=20,
-                    help="scan length per dispatch (and trace window)")
+                    help="scan length per dispatch (and trace window; "
+                         "classifiers only — the llama family keeps "
+                         "the bench's 20-batch epoch)")
     ap.add_argument("--top", type=int, default=25)
     ns = ap.parse_args()
 
-    from bench import build_classifier
     from theanompi_tpu.parallel import default_devices
     from theanompi_tpu.utils import Recorder
     from theanompi_tpu.utils.trace_comm import report_of
 
-    # the EXACT setup bench.py measures (shared builder), with the
+    # the EXACT setup bench.py measures (shared builders), with the
     # scan length overridden so the trace window stays short
-    model, _, batch, _ = build_classifier(
-        ns.model, batch=ns.batch, nb=ns.steps
+    llama_family = ns.model in (
+        "llama", "moe", "llama_long", "llama_hd128"
     )
-    n = len(default_devices())
+    import os
+
+    t0 = time.perf_counter()
+    if llama_family:
+        from bench import build_llama
+
+        model, cfg, ov, devices = build_llama(
+            moe=ns.model == "moe",
+            long=ns.model == "llama_long",
+            hd128=ns.model == "llama_hd128",
+            batch=ns.batch,
+        )
+        batch, unit = cfg["batch_size"] * cfg["seq_len"], "tok"
+        n = len(devices)
+    else:
+        from bench import build_classifier
+
+        model, _, batch, _ = build_classifier(
+            ns.model, batch=ns.batch, nb=ns.steps
+        )
+        unit = "img"
+        from bench import _env_cfg_overrides
+
+        ov = _env_cfg_overrides()
+        n = len(default_devices())
+    if ov:
+        # capture-integrity rule: anything measured under an overlay
+        # says so (bench rows carry cfg_overrides; the profiler prints)
+        print(f"cfg_overrides active: {ov}")
+    elif os.environ.get("TM_BENCH_CFG"):
+        print("NOTE: TM_BENCH_CFG is set but inactive here "
+              "(overlays apply only under TM_BENCH_MODEL focused "
+              "runs; use --batch, or export TM_BENCH_MODEL)")
 
     rec = Recorder(verbose=False)
     nb = model.data.n_batch_train
-    t0 = time.perf_counter()
     model.train_chunk(0, model.preferred_chunk(nb), rec)
     rec.flush()
     print(f"warmup (compile) {time.perf_counter() - t0:.1f}s")
+    if llama_family:
+        # the llama family's FIRST post-compile scan runs ~10% slow
+        # (see bench_llama's second-warmup note); skip it so the
+        # printed rate matches what the bench reports
+        model.train_chunk(0, model.preferred_chunk(nb), rec)
+        rec.flush()
     t0 = time.perf_counter()
     model.train_chunk(0, model.preferred_chunk(nb), rec)
     rec.flush()
+    steps = model.preferred_chunk(nb)
     dt = time.perf_counter() - t0
-    print(f"rate: {ns.steps * batch * n / dt:.1f} img/s "
-          f"({dt / ns.steps * 1e3:.2f} ms/step)")
+    print(f"rate: {steps * batch * n / dt:.1f} {unit}/s "
+          f"({dt / steps * 1e3:.2f} ms/step)")
 
     def warm_scan():
         model.train_chunk(0, model.preferred_chunk(nb), rec)

@@ -1,0 +1,58 @@
+"""Unit tests for bench.py's capture-protocol helpers (r5: the
+variance fields and the A/B override channel are part of the
+performance record's integrity — docs/PERFORMANCE.md "Capture
+protocol").  Pure host-side logic, fast tier."""
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from bench import _env_cfg_overrides, _window_stats  # noqa: E402
+
+
+class TestWindowStats:
+    def test_median_spread_windows(self):
+        s = _window_stats([100.0, 90.0, 110.0])
+        assert s["n_windows"] == 3
+        assert s["windows"] == [100.0, 90.0, 110.0]  # capture order
+        # spread = (max-min)/median
+        assert abs(s["spread"] - 20.0 / 100.0) < 1e-9
+
+    def test_single_window(self):
+        s = _window_stats([50.0])
+        assert s["n_windows"] == 1 and s["spread"] == 0.0
+
+    def test_zero_median_guard(self):
+        assert _window_stats([0.0, 0.0, 0.0])["spread"] is None
+
+
+class TestEnvCfgOverrides:
+    def test_ignored_without_focused_run(self, monkeypatch):
+        """A leftover TM_BENCH_CFG must never pollute a full-bench
+        capture: the overlay is honored only when TM_BENCH_MODEL
+        selects a focused run."""
+        monkeypatch.delenv("TM_BENCH_MODEL", raising=False)
+        monkeypatch.setenv("TM_BENCH_CFG", '{"batch_size": 4}')
+        assert _env_cfg_overrides() == {}
+
+    def test_applied_in_focused_run(self, monkeypatch):
+        monkeypatch.setenv("TM_BENCH_MODEL", "resnet50")
+        monkeypatch.setenv("TM_BENCH_CFG", '{"stage1_width": 128}')
+        assert _env_cfg_overrides() == {"stage1_width": 128}
+
+    def test_empty_when_unset(self, monkeypatch):
+        monkeypatch.setenv("TM_BENCH_MODEL", "resnet50")
+        monkeypatch.delenv("TM_BENCH_CFG", raising=False)
+        assert _env_cfg_overrides() == {}
+
+    def test_bad_json_raises(self, monkeypatch):
+        """A malformed overlay must fail loudly, not silently bench
+        the default config while the operator believes the A/B ran."""
+        import pytest
+
+        monkeypatch.setenv("TM_BENCH_MODEL", "resnet50")
+        monkeypatch.setenv("TM_BENCH_CFG", "{not json")
+        with pytest.raises(json.JSONDecodeError):
+            _env_cfg_overrides()
