@@ -89,6 +89,24 @@ def test_conv_s2d_rejects_bad_geometry():
     assert y.shape == (1, *out_shape)
 
 
+def test_pool_bwd_disable_values(monkeypatch):
+    """Disable-style TM_POOL_BWD values select the default backward
+    instead of raising at construction (ADVICE r5); unknown values
+    still fail fast."""
+    from theanompi_tpu.ops import Pool
+
+    for v in ("0", "off", "default", "none", "OFF", " Default "):
+        monkeypatch.setenv("TM_POOL_BWD", v)
+        assert Pool(2).bwd == ""
+    monkeypatch.setenv("TM_POOL_BWD", "tiesplit")
+    assert Pool(2).bwd == "tiesplit"
+    monkeypatch.setenv("TM_POOL_BWD", "bogus")
+    with pytest.raises(ValueError):
+        Pool(2)
+    # an explicit constructor arg outranks the env
+    assert Pool(2, bwd="").bwd == ""
+
+
 def test_pool_max_avg_match_torch(rng):
     x = rng.normal(size=(2, 8, 8, 3)).astype(np.float32)
     tx = torch.tensor(x.transpose(0, 3, 1, 2))

@@ -106,6 +106,41 @@ def test_llama8b_hbm_sizing():
     assert no_remat["total_gb"] < 16.0
 
 
+def test_zero1_hbm_accounting():
+    """ZeRO-1 (exch_strategy='zero1') shards fp32 adam m+v 1/dp over
+    the data axis: opt bytes divide by dp, everything else is
+    unchanged, and the predicted max batch at fixed HBM rises."""
+    from theanompi_tpu.utils.scaling_model import llama_max_batch
+
+    base = llama_hbm_per_chip(
+        LLAMA3_8B, tp=8, batch_per_replica=1, seq_len=2048
+    )
+    z8 = llama_hbm_per_chip(
+        LLAMA3_8B, tp=8, dp=8, zero1=True,
+        batch_per_replica=1, seq_len=2048,
+    )
+    assert z8["opt_gb"] == pytest.approx(base["opt_gb"] / 8)
+    for k in ("params_gb", "grads_gb", "acts_gb"):
+        assert z8[k] == base[k]
+    # zero1=False ignores dp entirely (replicated state)
+    same = llama_hbm_per_chip(
+        LLAMA3_8B, tp=8, dp=64, zero1=False,
+        batch_per_replica=1, seq_len=2048,
+    )
+    assert same["opt_gb"] == base["opt_gb"]
+
+    # the 8B-at-tp8 headline: replicated adam does not fit at ANY
+    # batch; zero1 fits a real batch
+    assert llama_max_batch(LLAMA3_8B, tp=8, dp=8, zero1=False) == 0
+    assert llama_max_batch(LLAMA3_8B, tp=8, dp=8, zero1=True) >= 2
+    # and max batch is monotone in the optimizer bytes freed
+    proxy = dict(dim=1024, n_layers=8, n_heads=16, n_kv_heads=8,
+                 ffn_dim=2816, vocab=32000, seq_len=2048)
+    mb_ar = llama_max_batch(proxy, dp=8, zero1=False)
+    mb_z1 = llama_max_batch(proxy, dp=8, zero1=True)
+    assert mb_z1 > mb_ar > 0
+
+
 def test_llama8b_step_time_prediction():
     """Predicted 8B step time at the r3 measured proxy MFU: the
     PODS.md number a future pod run is checked against."""

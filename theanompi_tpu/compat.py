@@ -1,0 +1,115 @@
+"""Version shims: run the newer-JAX surface this codebase targets on
+older jaxlibs (this image ships 0.4.x).
+
+The framework is written against the current ``jax.shard_map`` /
+varying-manual-axes ("vma") API.  On 0.4.x those names don't exist:
+``shard_map`` lives in ``jax.experimental`` with a ``check_rep`` flag,
+and there is no vma metadata at all.  ``install()`` fills exactly the
+four missing names, with semantics chosen for the UNCHECKED manual
+mode this framework runs its hot paths in:
+
+- ``jax.shard_map(f, mesh=..., in_specs=..., out_specs=...,
+  check_vma=...)`` → experimental ``shard_map`` with
+  ``check_rep=False``.  Unchecked manual mode inserts NO implicit
+  collectives in autodiff, so gradients come back as per-shard local
+  values and the strategy's explicit allreduce-mean IS the exchange —
+  the exact contract ``models/base.py`` (``check_vma=False``) and the
+  Llama step's dp-varying pre-cast encode.  (The vma-checked
+  tp>1 transpose insertion has no 0.4.x equivalent; pure-DP math is
+  bit-identical.)
+- ``lax.axis_size(name)`` → the static ``psum(1, name)`` trick
+  (returns a Python int at trace time; tuples multiply out).
+- ``lax.pcast(x, axes, to="varying")`` → identity.  With no vma
+  tracking every manual value is already "varying"; the cast only
+  exists to steer the checked mode's transpose insertion.
+- ``jax.typeof(x)`` → a view over ``jax.core.get_aval(x)`` whose
+  ``.vma`` is the empty frozenset (matching the everything-varying
+  reading above: code that asks "which axes am I missing from vma"
+  gets "none", so its conditional pcasts no-op).
+
+``install()`` is idempotent, only adds names that are MISSING, and is
+called once from ``theanompi_tpu/__init__``.  On a current jax it does
+nothing at all.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+#: True once :func:`install` had to add ANY shim — i.e. the running
+#: jax predates the targeted API.  Feature gates (e.g. the persistent
+#: compile cache, whose executable (de)serialization corrupts the
+#: heap on 0.4.x CPU — segfault/abort mid-suite, reproduced on this
+#: image) key off this instead of fragile version-string parsing.
+SHIMMED = False
+
+
+class _AvalView:
+    """``jax.typeof`` stand-in: the aval, plus an empty ``.vma``."""
+
+    __slots__ = ("_aval",)
+
+    def __init__(self, aval):
+        object.__setattr__(self, "_aval", aval)
+
+    @property
+    def vma(self):
+        return frozenset()
+
+    def __getattr__(self, name):
+        return getattr(object.__getattribute__(self, "_aval"), name)
+
+    def __repr__(self):
+        return f"_AvalView({object.__getattribute__(self, '_aval')!r})"
+
+
+def install() -> None:
+    global SHIMMED
+    if not hasattr(jax, "shard_map"):
+        SHIMMED = True
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True,
+                      **unused):
+            # check_vma (either value) → unchecked manual mode: no
+            # implicit collectives in autodiff (see module docstring)
+            del check_vma, unused
+            return _shard_map(
+                f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_rep=False,
+            )
+
+        jax.shard_map = shard_map
+
+    if not hasattr(lax, "axis_size"):
+        SHIMMED = True
+
+        def axis_size(axis_name):
+            if isinstance(axis_name, (tuple, list)):
+                n = 1
+                for a in axis_name:
+                    n *= axis_size(a)
+                return n
+            # psum of a Python scalar is evaluated at trace time:
+            # returns the (static) axis size
+            return lax.psum(1, axis_name)
+
+        lax.axis_size = axis_size
+
+    if not hasattr(lax, "pcast"):
+        SHIMMED = True
+
+        def pcast(x, axis_name, *, to="varying"):
+            del axis_name, to
+            return x
+
+        lax.pcast = pcast
+
+    if not hasattr(jax, "typeof"):
+        SHIMMED = True
+
+        def typeof(x):
+            return _AvalView(jax.core.get_aval(x))
+
+        jax.typeof = typeof

@@ -33,8 +33,10 @@ from theanompi_tpu.ops.layers import accuracy, softmax_cross_entropy
 from theanompi_tpu.parallel import (
     DATA_AXIS,
     allreduce_mean,
+    flat_spec,
     get_strategy,
     make_mesh,
+    scatter_update_gather,
 )
 from theanompi_tpu.utils import (
     Recorder,
@@ -179,6 +181,10 @@ class TMModel:
             trees, meta = load_checkpoint(path, self.checkpoint_trees())
         for group, tree in trees.items():
             setattr(self, group, tree)
+        # compile_iter_fns consults this: compiling with a zero1
+        # strategy AFTER a restore must not silently zero the restored
+        # optimizer state (cross-layout resume needs compile-then-load)
+        self._restored_opt = "opt_state" in trees
         self.epoch = int(meta.get("epoch", 0))
         self.current_lr = float(meta.get("lr", self.current_lr))
         if recorder is not None and "recorder" in meta:
@@ -258,6 +264,58 @@ class ClassifierModel(TMModel):
         net = self.net
         optimizer = self.optimizer
 
+        # ZeRO-1 (strat.zero1): optimizer state lives as a FLAT 1/N
+        # shard per data-axis device instead of a replicated pytree —
+        # the step body swaps allreduce-then-update for
+        # scatter_update_gather (reduce-scatter grads → update the
+        # shard → all-gather updated params).  Per-chip optimizer HBM
+        # drops ~1/N; the wire moves the same bytes as the two-phase
+        # allreduce.
+        n_dp = self.mesh.shape[DATA_AXIS]
+        zspec = flat_spec(self.params, n_dp) if strat.zero1 else None
+        if strat.zero1:
+            shard_state = optimizer.shard_state(zspec.shard_len)
+            if getattr(self, "_restored_opt", False):
+                # a restore happened BEFORE this compile.  Same-layout
+                # state (a zero1 checkpoint: flat [padded] buffers) is
+                # preserved; anything else would be silently zeroed
+                # below — refuse instead (compile-then-load is the
+                # supported resume order; cross-strategy resume is not)
+                zero1_layout = jax.tree.structure(
+                    self.opt_state
+                ) == jax.tree.structure(shard_state) and all(
+                    jnp.shape(l) == (zspec.padded,)
+                    for l in jax.tree.leaves(self.opt_state)
+                    if jnp.ndim(l)
+                )
+                if not zero1_layout:
+                    raise ValueError(
+                        "compile_iter_fns(exch_strategy='zero1') "
+                        "after a checkpoint restore would silently "
+                        "discard the restored optimizer state (the "
+                        "zero1 layout is a flat 1/N shard, not the "
+                        "restored tree) — compile first, then "
+                        "load(); cross-strategy resume is not "
+                        "supported"
+                    )
+            else:
+                # global arrays: [padded] sharded over data (each
+                # device holds its own [padded/N] slice); scalars
+                # (adam's t) stay replicated
+                self.opt_state = jax.tree.map(
+                    lambda x: jnp.zeros((zspec.padded,), x.dtype)
+                    if jnp.ndim(x) else x,
+                    shard_state,
+                )
+            opt_spec = jax.tree.map(
+                lambda x: P(DATA_AXIS) if jnp.ndim(x) else P(),
+                shard_state,
+            )
+        else:
+            opt_spec = P()
+        self._opt_specs = opt_spec
+        self._zero1 = strat.zero1
+
         def loss_fn(params, net_state, x, y, rng):
             out, new_state = net.apply(
                 params, net_state, self.prep_input(x), train=True, rng=rng
@@ -272,9 +330,6 @@ class ClassifierModel(TMModel):
             (loss, (new_state, err)), grads = grad_fn(
                 params, net_state, x, y, rng
             )
-            # THE exchange: BSP allreduce folded into the step
-            # (reference: BSP_Exchanger.exchange between train iters).
-            grads = strat(grads, DATA_AXIS)
             # net_state (BN statistics) rides the same in-step reduce.
             # The reference kept per-GPU local stats with rare syncs to
             # save wire; here the stats are ~KBs vs the MB-scale grad
@@ -284,7 +339,26 @@ class ClassifierModel(TMModel):
             new_state = allreduce_mean(new_state, DATA_AXIS)
             loss = lax.pmean(loss, DATA_AXIS)
             err = lax.pmean(err, DATA_AXIS)
-            params, opt_state = optimizer.update(params, grads, opt_state, lr)
+            if strat.zero1:
+                # ZeRO-1 exchange: reduce-scatter grads, update the
+                # optimizer on this device's 1/N flat shard, all-gather
+                # the updated params (same wire bytes as two-phase
+                # allreduce, optimizer HBM /N)
+                def opt_upd(p_shard, g_shard):
+                    return optimizer.update(p_shard, g_shard, opt_state, lr)
+
+                params, opt_state = scatter_update_gather(
+                    params, grads, opt_upd, DATA_AXIS,
+                    wire_dtype=strat.wire_dtype, spec=zspec,
+                )
+            else:
+                # THE exchange: BSP allreduce folded into the step
+                # (reference: BSP_Exchanger.exchange between train
+                # iters).
+                grads = strat(grads, DATA_AXIS)
+                params, opt_state = optimizer.update(
+                    params, grads, opt_state, lr
+                )
             return params, new_state, opt_state, loss, err
 
         def shard_val(params, net_state, x, y):
@@ -305,8 +379,8 @@ class ClassifierModel(TMModel):
             jax.shard_map(
                 shard_train,
                 mesh=self.mesh,
-                in_specs=(rep, rep, rep, dp, dp, rep, rep),
-                out_specs=(rep, rep, rep, rep, rep),
+                in_specs=(rep, rep, opt_spec, dp, dp, rep, rep),
+                out_specs=(rep, rep, opt_spec, rep, rep),
                 check_vma=False,
             ),
             donate_argnums=(0, 1, 2),
@@ -330,10 +404,18 @@ class ClassifierModel(TMModel):
             )
         )
 
-        # place params replicated on the mesh
+        # place params replicated on the mesh; opt state follows its
+        # spec (data-sharded flat buffers under zero1, replicated else)
         rep_sharding = NamedSharding(self.mesh, P())
-        self.params, self.net_state, self.opt_state = jax.device_put(
-            (self.params, self.net_state, self.opt_state), rep_sharding
+        self.params, self.net_state = jax.device_put(
+            (self.params, self.net_state), rep_sharding
+        )
+        self.opt_state = jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
+            self.opt_state,
+            opt_spec if strat.zero1 else jax.tree.map(
+                lambda _: P(), self.opt_state
+            ),
         )
         self._data_sharding = NamedSharding(self.mesh, P(DATA_AXIS))
 
@@ -426,13 +508,14 @@ class ClassifierModel(TMModel):
             return p, s, o, step + 1, loss, err
 
         rep_s, dp = P(), P(DATA_AXIS)
+        osp = self._opt_specs  # zero1: data-sharded flat opt buffers
         self._train_step_cached = jax.jit(
             jax.shard_map(
                 shard_cached,
                 mesh=self.mesh,
-                in_specs=(rep_s, rep_s, rep_s, rep_s, rep_s, rep_s,
+                in_specs=(rep_s, rep_s, osp, rep_s, rep_s, rep_s,
                           rep_s, rep_s, rep_s),
-                out_specs=(rep_s, rep_s, rep_s, rep_s, rep_s, rep_s),
+                out_specs=(rep_s, rep_s, osp, rep_s, rep_s, rep_s),
                 check_vma=False,
             ),
             donate_argnums=(0, 1, 2, 3),
@@ -468,8 +551,8 @@ class ClassifierModel(TMModel):
                 jax.shard_map(
                     shard_cached_scan,
                     mesh=self.mesh,
-                    in_specs=(rep_s,) * 9,
-                    out_specs=(rep_s,) * 6,
+                    in_specs=(rep_s, rep_s, osp) + (rep_s,) * 6,
+                    out_specs=(rep_s, rep_s, osp) + (rep_s,) * 3,
                     check_vma=False,
                 ),
                 donate_argnums=(0, 1, 2, 3),
@@ -638,8 +721,19 @@ class ClassifierModel(TMModel):
         }
 
     def _place_restored(self) -> None:
-        if self.mesh is not None:
-            rep = NamedSharding(self.mesh, P())
-            self.params, self.net_state, self.opt_state = jax.device_put(
-                (self.params, self.net_state, self.opt_state), rep
-            )
+        if self.mesh is None:
+            return
+        rep = NamedSharding(self.mesh, P())
+        self.params, self.net_state = jax.device_put(
+            (self.params, self.net_state), rep
+        )
+        # opt state honors its compile-time layout (zero1: data-sharded
+        # flat buffers; a blanket replicated put would silently undo
+        # the sharded init the restore is supposed to preserve)
+        osp = getattr(self, "_opt_specs", P())
+        if isinstance(osp, P):
+            osp = jax.tree.map(lambda _: osp, self.opt_state)
+        self.opt_state = jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
+            self.opt_state, osp,
+        )

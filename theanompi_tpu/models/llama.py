@@ -49,6 +49,7 @@ contract as every zoo member, so ``BSP().init(modelfile=
 
 from __future__ import annotations
 
+import math
 from typing import Any
 
 import jax
@@ -72,6 +73,7 @@ from theanompi_tpu.parallel import (
     make_mesh,
     merge_microbatches,
     pipeline_apply,
+    scatter_update_gather,
     split_microbatches,
 )
 from theanompi_tpu.parallel.moe import moe_ffn
@@ -655,7 +657,66 @@ class Llama(TMModel):
             opt_specs = ()
         else:  # momentum / nesterov velocity
             opt_specs = specs
+
+        # ZeRO-1 (strat.zero1): m/v become FLAT buffers holding each
+        # DP replica's 1/N shard of the (already tp/pp-sharded) local
+        # parameter pack — per-chip optimizer HBM divides by the DP
+        # replica count on top of the tp*pp model sharding.  The flat
+        # buffer varies over every non-seq mesh axis: (model, pipe)
+        # from the param sharding x (expert, data) from the zero1
+        # scatter.
+        zero1 = strat.zero1
+        z_shard_len = None
+        z_state_proto = None
+        if zero1:
+            if self.n_experts:
+                raise NotImplementedError(
+                    "exch_strategy='zero1' does not yet compose with "
+                    "MoE expert sharding (n_experts > 0): expert "
+                    "leaves exchange over data alone while dense "
+                    "leaves exchange over (expert, data) — two "
+                    "separate shard groups"
+                )
+            shapes = jax.eval_shape(
+                self._init_full_params, jax.random.PRNGKey(0)
+            )
+
+            def _local_elems(leaf, spec):
+                dims = list(leaf.shape)
+                for i, ax in enumerate(tuple(spec)):
+                    if ax is None:
+                        continue
+                    for a in (ax if isinstance(ax, (tuple, list))
+                              else (ax,)):
+                        dims[i] //= mesh.shape[a]
+                return math.prod(dims)
+
+            local_size = sum(
+                _local_elems(l, s)
+                for l, s in zip(
+                    jax.tree.leaves(shapes),
+                    jax.tree.leaves(
+                        specs, is_leaf=lambda s: isinstance(s, P)
+                    ),
+                )
+            )
+            n_dp = dp_replicas(mesh)
+            z_shard_len = -(-local_size // n_dp)
+            z_flat_axes = tuple(
+                a for a in (PIPE_AXIS, EXPERT_AXIS, DATA_AXIS,
+                            MODEL_AXIS)
+                if a in mesh.shape
+            )
+            z_global_len = z_shard_len
+            for a in z_flat_axes:
+                z_global_len *= mesh.shape[a]
+            z_state_proto = self.optimizer.shard_state(z_shard_len)
+            opt_specs = jax.tree.map(
+                lambda x: P(z_flat_axes) if jnp.ndim(x) else P(),
+                z_state_proto,
+            )
         self._specs, self._opt_specs = specs, opt_specs
+        self._zero1 = zero1
         batch_spec = P(
             dp_axes if len(dp_axes) > 1 else dp_axes[0], SEQ_AXIS
         )
@@ -782,11 +843,33 @@ class Llama(TMModel):
                     return strat(g, dp_spec)
 
                 grads = jax.tree.map(exch, grads, expert_mask)
+                params, opt_state = optimizer.update(
+                    params, grads, opt_state, lr
+                )
+            elif zero1:
+                # ZeRO-1: reduce-scatter the packed local grads over
+                # the DP replica axes, update the optimizer on this
+                # device's flat 1/N shard (opt_state IS that shard —
+                # in_specs slice it), all-gather the updated params.
+                # Same wire bytes as the two-phase allreduce; the
+                # replicated fp32 m/v never exist.
+                def opt_upd(p_shard, g_shard):
+                    return optimizer.update(
+                        p_shard, g_shard, opt_state, lr
+                    )
+
+                params, new_opt = scatter_update_gather(
+                    params, grads, opt_upd, dp_spec,
+                    wire_dtype=strat.wire_dtype,
+                )
+                opt_state = new_opt
             else:
                 grads = strat(grads, dp_spec)
+                params, opt_state = optimizer.update(
+                    params, grads, opt_state, lr
+                )
             loss = lax.pmean(loss, dp_axes)
             err = lax.pmean(err, dp_axes)
-            params, opt_state = optimizer.update(params, grads, opt_state, lr)
             return params, opt_state, loss, err
 
         def val(params, x, y):
@@ -840,7 +923,18 @@ class Llama(TMModel):
 
             def init(key):
                 params = self._init_full_params(key)
-                return params, self.optimizer.init(params)
+                if zero1:
+                    # shard-shaped zero1 state: flat zeros, sliced
+                    # onto the mesh by out_shardings (the full
+                    # replicated m/v never materialize)
+                    opt = jax.tree.map(
+                        lambda x: jnp.zeros((z_global_len,), x.dtype)
+                        if jnp.ndim(x) else x,
+                        z_state_proto,
+                    )
+                else:
+                    opt = self.optimizer.init(params)
+                return params, opt
 
             self.params, self.opt_state = jax.jit(
                 init, out_shardings=(shardings, opt_shardings),

@@ -370,10 +370,17 @@ class Pool(Layer):
         self.bwd = (
             bwd if bwd is not None else os.environ.get("TM_POOL_BWD", "")
         )
+        # disable-style spellings select the default backward: a
+        # leftover ``TM_POOL_BWD=0`` / ``off`` / ``default`` from an
+        # A/B run must not fail model construction (ADVICE r5)
+        if self.bwd.strip().lower() in (
+            "", "0", "off", "default", "none", "false",
+        ):
+            self.bwd = ""
         if self.bwd not in ("", "tiesplit"):
             raise ValueError(
-                f"unknown Pool bwd {self.bwd!r} (expected '' or "
-                f"'tiesplit')"
+                f"unknown Pool bwd {self.bwd!r} (expected 'tiesplit' or "
+                f"a disable value: ''/'0'/'off'/'default'/'none')"
             )
         self.size = (size, size) if isinstance(size, int) else size
         stride = stride if stride is not None else size

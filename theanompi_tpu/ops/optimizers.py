@@ -30,6 +30,15 @@ class Optimizer:
     update: Callable[..., tuple[PyTree, PyTree]]
     # update(params, grads, opt_state, lr) -> (new_params, new_opt_state)
 
+    def shard_state(self, shard_len: int, dtype=jnp.float32) -> PyTree:
+        """SHARD-shaped state for the ZeRO-1 exchange: the state of a
+        flat ``[shard_len]`` 1/N parameter shard (momentum velocity /
+        adam m+v become flat buffers; adam's step counter stays a
+        replicated scalar).  Every update here is an elementwise
+        ``tree.map``, so ``update`` applies to flat shards unchanged —
+        ``init`` on a flat zeros buffer IS the shard constructor."""
+        return self.init(jnp.zeros((shard_len,), dtype))
+
 
 def _tree_zeros_like(tree):
     return jax.tree.map(jnp.zeros_like, tree)

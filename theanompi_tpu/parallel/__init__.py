@@ -26,7 +26,12 @@ from theanompi_tpu.parallel.pp import (
     merge_microbatches,
 )
 from theanompi_tpu.parallel.exchange import (
+    FlatSpec,
     allreduce_mean,
+    flat_pack,
+    flat_spec,
+    flat_unpack,
+    scatter_update_gather,
     elastic_pair_update,
     elastic_center_merge,
     elastic_center_merge_masked,
@@ -63,7 +68,12 @@ __all__ = [
     "last_stage_value",
     "split_microbatches",
     "merge_microbatches",
+    "FlatSpec",
     "allreduce_mean",
+    "flat_pack",
+    "flat_spec",
+    "flat_unpack",
+    "scatter_update_gather",
     "elastic_pair_update",
     "elastic_center_merge",
     "elastic_center_merge_masked",

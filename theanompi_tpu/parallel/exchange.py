@@ -19,6 +19,8 @@ TPU-native rebuild of the reference's parameter-exchange layer
 
 from __future__ import annotations
 
+import math
+from dataclasses import dataclass, field
 from functools import partial
 from typing import Any
 
@@ -81,6 +83,159 @@ def allreduce_mean(
         return (w / n).astype(orig)
 
     return jax.tree.map(one, tree)
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-1: sharded optimizer states over the data axis (Rajbhandari et
+# al. 2020).  The reference's asa* strategies were already two-phase
+# reduce-scatter + all-gather (the exact ZeRO wire shape) — but then
+# kept full replicated optimizer state on every chip.  ZeRO-1 finishes
+# the move: update the optimizer on the 1/N gradient shard only and
+# all-gather the UPDATED PARAMS instead of the reduced grads, cutting
+# per-chip optimizer HBM by ~1/N for the same bytes on the wire.
+#
+# Pytree leaves are uneven, so the exchange runs over ONE contiguous
+# flat buffer: pad-and-concat every leaf (FlatSpec below), shard the
+# buffer evenly, unpack after the gather.
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class FlatSpec:
+    """Static layout of a pytree packed into one padded flat buffer.
+
+    Built once at trace time (`flat_spec`); `flat_pack`/`flat_unpack`
+    are pure jittable functions over it.  ``padded`` is ``size``
+    rounded up so the buffer shards evenly over ``n_shards`` devices.
+    """
+
+    treedef: Any = field(repr=False)
+    shapes: tuple
+    dtypes: tuple
+    dtype: Any            # buffer dtype (the optimizer's master width)
+    size: int             # live elements
+    padded: int           # size rounded up to n_shards
+    n_shards: int
+
+    @property
+    def shard_len(self) -> int:
+        return self.padded // self.n_shards
+
+
+def flat_spec(tree: PyTree, n_shards: int, dtype=None) -> FlatSpec:
+    """Layout for packing ``tree`` into one buffer sharded ``n`` ways.
+
+    ``dtype``: buffer dtype; default is the common leaf dtype (fp32
+    when leaves disagree — the optimizer master width)."""
+    leaves, treedef = jax.tree.flatten(tree)
+    shapes = tuple(tuple(jnp.shape(x)) for x in leaves)
+    dtypes = tuple(jnp.asarray(x).dtype if not hasattr(x, "dtype")
+                   else x.dtype for x in leaves)
+    if dtype is None:
+        dtype = dtypes[0] if len(set(dtypes)) == 1 else jnp.float32
+    size = sum(math.prod(s) for s in shapes)
+    padded = -(-size // n_shards) * n_shards
+    return FlatSpec(
+        treedef=treedef, shapes=shapes, dtypes=dtypes,
+        dtype=jnp.dtype(dtype), size=size, padded=padded,
+        n_shards=n_shards,
+    )
+
+
+def flat_pack(tree: PyTree, spec: FlatSpec) -> jnp.ndarray:
+    """Concat every raveled leaf (+ zero pad) into ``[spec.padded]``."""
+    leaves = jax.tree.leaves(tree)
+    parts = [jnp.ravel(x).astype(spec.dtype) for x in leaves]
+    if spec.padded > spec.size:
+        parts.append(jnp.zeros((spec.padded - spec.size,), spec.dtype))
+    return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+
+
+def flat_unpack(buf: jnp.ndarray, spec: FlatSpec) -> PyTree:
+    """Inverse of ``flat_pack`` (pad dropped, leaf dtypes restored)."""
+    out, off = [], 0
+    for shape, dt in zip(spec.shapes, spec.dtypes):
+        n = math.prod(shape)
+        out.append(lax.slice_in_dim(buf, off, off + n).reshape(shape)
+                   .astype(dt))
+        off += n
+    return jax.tree_util.tree_unflatten(spec.treedef, out)
+
+
+def _flat_axis_index(axes: tuple) -> jnp.ndarray:
+    """This device's flattened index over ``axes`` (first axis major —
+    the order `psum_scatter`/`all_gather` tile shards in)."""
+    idx = None
+    for a in axes:
+        i = lax.axis_index(a)
+        idx = i if idx is None else idx * lax.axis_size(a) + i
+    return idx
+
+
+def _pvary(x, axes: tuple):
+    """Idempotent invariant→varying cast over ``axes``: under a
+    vma-checked shard_map the param pack enters dp-INVARIANT and the
+    varying-index slice below would be rejected; outside checked mode
+    (and on shimmed 0.4.x jax) this is an identity."""
+    vma = getattr(jax.typeof(x), "vma", frozenset())
+    need = tuple(a for a in axes if a not in vma)
+    return lax.pcast(x, need, to="varying") if need else x
+
+
+def scatter_update_gather(
+    params: PyTree,
+    grads: PyTree,
+    opt_update,
+    axis_name: str | tuple[str, ...],
+    *,
+    wire_dtype=None,
+    spec: FlatSpec | None = None,
+) -> tuple[PyTree, Any]:
+    """ZeRO-1 exchange + update, inside ``shard_map``.
+
+    1. pack ``grads`` into one flat buffer and ``psum_scatter`` it over
+       ``axis_name`` — each device ends holding the MEAN of its 1/N
+       gradient shard (the reduce-scatter half of the reference's
+       ``asa*`` ring);
+    2. ``opt_update(param_shard, grad_shard) -> (new_param_shard,
+       aux)`` applies the optimizer on that shard only — ``aux``
+       (the updated shard-shaped optimizer state) stays sharded;
+    3. ``all_gather`` the UPDATED param shards back to the full flat
+       buffer (the all-gather half), unpack to the original pytree.
+
+    ``wire_dtype`` casts the gradient buffer for the reduce-scatter
+    (the ``*16`` strategies' half-width wire); the param gather rides
+    in the master dtype — a bf16 gather would truncate the master
+    weights and break equivalence with the allreduce path.
+
+    Returns ``(new_params, aux)``.
+    """
+    axes = axis_name if isinstance(axis_name, tuple) else (axis_name,)
+    n = 1
+    for a in axes:
+        n *= lax.axis_size(a)
+    if spec is None:
+        spec = flat_spec(params, n)
+    assert spec.n_shards == n, (spec.n_shards, n)
+
+    g_flat = flat_pack(grads, spec)
+    w = g_flat if wire_dtype is None else g_flat.astype(wire_dtype)
+    g_shard = lax.psum_scatter(w, axes, scatter_dimension=0, tiled=True)
+    g_shard = g_shard.astype(spec.dtype) / n
+
+    p_flat = _pvary(flat_pack(params, spec), axes)
+    p_shard = lax.dynamic_slice_in_dim(
+        p_flat, _flat_axis_index(axes) * spec.shard_len, spec.shard_len
+    )
+    new_p_shard, aux = opt_update(p_shard, g_shard)
+    # all_gather_invariant (vma-checked jax): the gathered params are
+    # identical on every shard and must re-enter the step dp-INVARIANT
+    # to match the params' out_spec; plain all_gather on older jax
+    gather = getattr(lax, "all_gather_invariant", lax.all_gather)
+    p_new = gather(
+        new_p_shard.astype(spec.dtype), axes, axis=0, tiled=True
+    )
+    return flat_unpack(p_new, spec), aux
 
 
 # ---------------------------------------------------------------------------

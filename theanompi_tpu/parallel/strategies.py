@@ -34,11 +34,21 @@ from theanompi_tpu.parallel.exchange import allreduce_mean
 
 @dataclasses.dataclass(frozen=True)
 class ExchangeStrategy:
-    """A named allreduce flavor: wire dtype + collective shape."""
+    """A named allreduce flavor: wire dtype + collective shape.
+
+    ``zero1=True`` marks the ZeRO-1 strategies: the models swap the
+    allreduce-then-replicated-update step body for
+    ``exchange.scatter_update_gather`` (reduce-scatter grads → update
+    the optimizer on the 1/N shard → all-gather updated params) and
+    initialize SHARD-shaped optimizer state.  Calling a zero1 strategy
+    directly still allreduce-means (the two-phase wire it shares) —
+    auxiliary exchanges like BN-stat sync route through it unchanged.
+    """
 
     name: str
     wire_dtype: Optional[Any]       # None = native dtype on the wire
     two_phase: bool                  # reduce_scatter+all_gather vs psum
+    zero1: bool = False              # sharded-optimizer step body
 
     def __call__(self, tree, axis_name: str | tuple[str, ...]):
         return allreduce_mean(
@@ -60,6 +70,10 @@ STRATEGIES: dict[str, ExchangeStrategy] = {
         # TPU-native aliases (preferred spelling in new configs):
         ExchangeStrategy("ici32", None, False),
         ExchangeStrategy("ici16", jnp.bfloat16, False),
+        # ZeRO-1: the asa* two-phase wire, optimizer state sharded 1/N
+        # over the data axis (zero1_16 = bf16 gradient wire analogue)
+        ExchangeStrategy("zero1", None, True, zero1=True),
+        ExchangeStrategy("zero1_16", jnp.bfloat16, True, zero1=True),
     )
 }
 

@@ -20,6 +20,16 @@ def enable_compile_cache(default_dir: str | None = None) -> str | None:
     failure."""
     import jax
 
+    from theanompi_tpu import compat
+
+    if compat.SHIMMED and os.environ.get("TM_FORCE_COMPILE_CACHE") != "1":
+        # 0.4.x jaxlibs corrupt the heap (segfault / "corrupted
+        # double-linked list" abort, reproduced on this image's CPU
+        # backend) when persisting these shard_map executables; on a
+        # shimmed jax the cache is disabled — correctness over warm
+        # compiles.  TM_FORCE_COMPILE_CACHE=1 overrides.
+        return None
+
     cache = os.environ.get("TM_TEST_CACHE")
     if not cache:
         cache = default_dir or os.path.join(

@@ -176,6 +176,8 @@ def llama_hbm_per_chip(
     tp: int = 1,
     sp: int = 1,
     pp: int = 1,
+    dp: int = 1,
+    zero1: bool = False,
     batch_per_replica: int = 1,
     seq_len: int | None = None,
     remat: bool = True,
@@ -189,7 +191,13 @@ def llama_hbm_per_chip(
       norms replicated.  Approximation: the whole tree divides by
       tp*pp (norm weights are <0.01% of 8B).
     - optimizer: adam m+v fp32 over the same shard (momentum: 1x).
-    - gradients: one fp32 shadow of the shard (transient but peak).
+      With ``zero1=True`` (the ``zero1`` exchange strategy) the m+v
+      buffers additionally shard 1/dp over the data axis — the ZeRO-1
+      win: per-chip optimizer bytes divide by the DP replica count,
+      so predicted max batch RISES with N (``llama_max_batch``).
+    - gradients: one fp32 shadow of the shard (transient but peak;
+      zero1 reduce-scatters them on the wire but the pre-exchange
+      local grads still exist at peak, so they do NOT divide by dp).
     - activations (remat=True): each layer saves its boundary input
       [B, T/sp, d] in compute dtype; plus the embed output, the
       final-norm input, and the flash residuals of ONE layer being
@@ -204,7 +212,8 @@ def llama_hbm_per_chip(
     shard = tp * pp
     p_bytes = 4.0 * P / shard
     opt_mult = {"adam": 2.0, "momentum": 1.0, "sgd": 0.0}[optimizer]
-    opt_bytes = opt_mult * 4.0 * P / shard
+    opt_shard = shard * (dp if zero1 else 1)
+    opt_bytes = opt_mult * 4.0 * P / opt_shard
     grad_bytes = 4.0 * P / shard
 
     d = int(cfg["dim"])
@@ -226,6 +235,46 @@ def llama_hbm_per_chip(
         "fits_16g": total < V5E.hbm_bytes,
         "param_count": P,
     }
+
+
+def llama_max_batch(
+    cfg: dict,
+    *,
+    tp: int = 1,
+    sp: int = 1,
+    pp: int = 1,
+    dp: int = 1,
+    zero1: bool = False,
+    seq_len: int | None = None,
+    remat: bool = True,
+    optimizer: str = "adam",
+    chip: ChipSpec = V5E,
+    limit: int = 65536,
+) -> int:
+    """Largest per-replica batch whose predicted per-chip HBM fits the
+    chip (the max-batch-at-fixed-HBM half of the zero1 A/B: freeing
+    ~opt_bytes*(1-1/dp) of HBM converts directly into batch — the
+    lever on the memory-limited zoo rows).  0 = even batch 1 spills."""
+
+    def fits(b: int) -> bool:
+        return (
+            llama_hbm_per_chip(
+                cfg, tp=tp, sp=sp, pp=pp, dp=dp, zero1=zero1,
+                batch_per_replica=b, seq_len=seq_len, remat=remat,
+                optimizer=optimizer,
+            )["total_gb"] * 2**30 < chip.hbm_bytes
+        )
+
+    if not fits(1):
+        return 0
+    lo, hi = 1, 2
+    while hi < limit and fits(hi):
+        lo, hi = hi, hi * 2
+    hi = min(hi, limit)
+    while lo + 1 < hi:
+        mid = (lo + hi) // 2
+        lo, hi = (mid, hi) if fits(mid) else (lo, mid)
+    return lo
 
 
 def llama_step_flops(cfg: dict, batch: int, seq_len: int | None = None,

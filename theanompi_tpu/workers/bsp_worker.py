@@ -79,13 +79,22 @@ def run(
     Model = _resolve_model(modelfile, modelclass)
     cfg = dict(config or {})
     cfg.update(extra)
+    # resolve the strategy BEFORE the (possibly multi-minute) model
+    # build so a typo'd name fails in milliseconds, and so the run
+    # summary can carry the resolved name (zero1 runs shard their
+    # optimizer state — the checkpoint format follows)
+    from theanompi_tpu.parallel import get_strategy
+
+    strat = get_strategy(
+        exch_strategy or cfg.get("exch_strategy", "ici32")
+    )
     mesh = _build_mesh(devices, cfg)
     n_replicas = dp_replicas(mesh)
     if n_epochs is not None:
         cfg["n_epochs"] = n_epochs
     model = Model(cfg)
     model.build_model(n_replicas=n_replicas)
-    model.compile_iter_fns(mesh=mesh, exch_strategy=exch_strategy)
+    model.compile_iter_fns(mesh=mesh, exch_strategy=strat.name)
 
     recorder = Recorder(
         rank=0, size=n_replicas, print_freq=print_freq, verbose=verbose
@@ -100,7 +109,9 @@ def run(
     if verbose:
         print(
             f"BSP: {n_replicas} replicas, {data.n_batch_train} train batches"
-            f" x {data.global_batch} global batch",
+            f" x {data.global_batch} global batch, "
+            f"exchange={strat.name}"
+            + (" (ZeRO-1 sharded optimizer)" if strat.zero1 else ""),
             flush=True,
         )
 
@@ -153,6 +164,7 @@ def run(
     last_val = recorder.val_records[-1] if recorder.val_records else {}
     return {
         "epochs": model.epoch,
+        "exch_strategy": strat.name,
         "iterations": recorder.n_iter,
         "final_train_loss": (
             recorder.train_losses[-1] if recorder.train_losses else None
