@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Tier-1 verify — the single source of truth for builder and CI.
+# The pytest invocation below is the ROADMAP.md "Tier-1 verify"
+# command VERBATIM; edit it only together with ROADMAP.md.
+#
+# Usage: bash scripts/tier1.sh   (from the repo root or anywhere —
+# it cd's to the repo first).
+
+cd "$(dirname "$0")/.." || exit 2
+
+# Syntax gate: a file that cannot even byte-compile (import-time
+# SyntaxError) must fail in seconds here, not as an opaque
+# collection error minutes into pytest.
+python -m compileall -q theanompi_tpu/ || {
+    echo "tier1: python -m compileall failed (syntax error above)" >&2
+    exit 2
+}
+
+# --- ROADMAP.md tier-1 verify, verbatim ---
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

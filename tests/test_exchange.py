@@ -13,7 +13,10 @@ from theanompi_tpu.parallel import (
     allreduce_mean,
     elastic_pair_update,
     flat_pack,
+    flat_pack_bucket,
     flat_spec,
+    flat_spec_cache_clear,
+    flat_spec_cache_info,
     flat_unpack,
     get_strategy,
     gossip_merge,
@@ -21,6 +24,7 @@ from theanompi_tpu.parallel import (
     make_mesh,
     scatter_update_gather,
 )
+from theanompi_tpu.parallel.exchange import flat_layout
 from theanompi_tpu.parallel.exchange import (
     elastic_center_merge,
     replica_consistency_delta,
@@ -356,6 +360,509 @@ class TestZero1Primitive:
         np.testing.assert_allclose(
             np.asarray(p1["w"]), want, rtol=2e-6, atol=2e-7
         )
+
+
+class TestFlatPackEdges:
+    """flat_pack/flat_unpack edge cases + bucket-boundary layouts
+    (ISSUE 2 satellite)."""
+
+    def test_zero_size_leaf_roundtrip(self, rng):
+        tree = {
+            "w": jnp.asarray(rng.normal(size=(4, 3)), jnp.float32),
+            "empty": jnp.zeros((0,), jnp.float32),
+            "e2": jnp.zeros((3, 0, 2), jnp.float32),
+        }
+        spec = flat_spec(tree, 8)
+        assert spec.size == 12
+        back = flat_unpack(flat_pack(tree, spec), spec)
+        for k in tree:
+            assert back[k].shape == tree[k].shape
+            np.testing.assert_array_equal(
+                np.asarray(back[k]), np.asarray(tree[k])
+            )
+
+    def test_fewer_leaves_than_shards(self, rng):
+        """2 leaves over 8 shards: padding must still shard evenly and
+        round-trip."""
+        tree = {
+            "a": jnp.asarray(rng.normal(size=(3,)), jnp.float32),
+            "b": jnp.float32(1.5),
+        }
+        spec = flat_spec(tree, 8)
+        assert spec.size == 4 and spec.padded == 8
+        assert spec.shard_len == 1
+        back = flat_unpack(flat_pack(tree, spec), spec)
+        np.testing.assert_array_equal(np.asarray(back["a"]),
+                                      np.asarray(tree["a"]))
+        assert float(back["b"]) == 1.5
+
+    def test_mixed_dtype_roundtrip(self, rng):
+        tree = {
+            "f32": jnp.asarray(rng.normal(size=(5,)), jnp.float32),
+            "bf16": jnp.asarray(rng.normal(size=(6,)), jnp.bfloat16),
+            "i32": jnp.arange(7, dtype=jnp.int32),
+        }
+        spec = flat_spec(tree, 4)
+        assert spec.dtype == jnp.float32          # mixed -> master fp32
+        back = flat_unpack(flat_pack(tree, spec), spec)
+        for k in tree:
+            assert back[k].dtype == tree[k].dtype
+            np.testing.assert_allclose(
+                np.asarray(back[k], np.float32),
+                np.asarray(tree[k], np.float32),
+                rtol=1e-2 if tree[k].dtype == jnp.bfloat16 else 0,
+            )
+
+    def test_pad_length_roundtrip_identity(self, rng):
+        """padded > size: the pad is dropped exactly, values identical."""
+        tree = {"w": jnp.asarray(rng.normal(size=(13,)), jnp.float32)}
+        spec = flat_spec(tree, 8)
+        assert spec.padded == 16 and spec.size == 13
+        buf = flat_pack(tree, spec)
+        assert buf.shape == (16,)
+        np.testing.assert_array_equal(np.asarray(buf[13:]), 0.0)
+        np.testing.assert_array_equal(
+            np.asarray(flat_unpack(buf, spec)["w"]),
+            np.asarray(tree["w"]),
+        )
+
+    def test_bucket_not_dividing_buffer(self, rng):
+        """bucket size not dividing the (mono-padded) buffer: padded
+        rounds up to a whole bucket count; concat of buckets equals
+        the monolithic pack on the live prefix."""
+        tree = {"w": jnp.asarray(rng.normal(size=(50,)), jnp.float32)}
+        # 8 shards: mono padded 56; bucket_elems 20 -> bucket_len 24,
+        # padded 72, 3 buckets
+        spec = flat_spec(tree, 8, bucket_elems=20)
+        assert (spec.bucket_len, spec.padded, spec.n_buckets) == (24, 72, 3)
+        assert spec.bucket_shard_len == 3
+        parts = jnp.concatenate([
+            flat_pack_bucket(tree, spec, i) for i in range(spec.n_buckets)
+        ])
+        np.testing.assert_array_equal(
+            np.asarray(parts), np.asarray(flat_pack(tree, spec))
+        )
+        np.testing.assert_array_equal(
+            np.asarray(flat_unpack(parts, spec)["w"]),
+            np.asarray(tree["w"]),
+        )
+
+    def test_bucket_count_cap(self):
+        """The unrolled pipeline's HLO size is linear in bucket
+        count, so flat_layout caps it by growing the bucket size —
+        a flagship-scale pack at a tiny bucket target must not
+        unroll thousands of bodies."""
+        from theanompi_tpu.parallel.exchange import MAX_EXCHANGE_BUCKETS
+
+        padded, bl = flat_layout(10_000_000, 8, 1000)
+        assert bl > 0
+        assert padded // bl <= MAX_EXCHANGE_BUCKETS
+        # uncapped requests keep their size
+        padded, bl = flat_layout(10_000_000, 8, 4 * 2**20 // 4)
+        assert bl == 4 * 2**20 // 4
+        assert padded // bl <= MAX_EXCHANGE_BUCKETS
+
+    def test_resolve_bucket_mb(self):
+        from theanompi_tpu.parallel import (
+            DEFAULT_BUCKET_MB,
+            resolve_bucket_mb,
+        )
+
+        assert resolve_bucket_mb(None) == DEFAULT_BUCKET_MB
+        assert resolve_bucket_mb({}) == DEFAULT_BUCKET_MB
+        assert resolve_bucket_mb({"exchange_bucket_mb": 0}) == 0.0
+        assert resolve_bucket_mb({"exchange_bucket_mb": None}) == 0.0
+        assert resolve_bucket_mb({"exchange_bucket_mb": 0.25}) == 0.25
+        with pytest.raises(ValueError, match="exchange_bucket_mb"):
+            resolve_bucket_mb({"exchange_bucket_mb": -1})
+
+    def test_bucket_larger_than_buffer_degrades_to_monolithic(self, rng):
+        tree = {"w": jnp.asarray(rng.normal(size=(50,)), jnp.float32)}
+        spec = flat_spec(tree, 8, bucket_elems=1000)
+        assert spec.bucket_len == 0 and spec.n_buckets == 1
+        assert spec.padded == 56                 # the monolithic layout
+        # and the degraded spec is the SAME layout flat_layout computes
+        assert flat_layout(50, 8, 1000) == (56, 0)
+        assert flat_layout(50, 8, 0) == (56, 0)
+        assert flat_layout(50, 8, 20) == (72, 24)
+
+    def test_bucket_pack_covers_leaf_boundaries(self, rng):
+        """Leaves spanning bucket boundaries and buckets fully inside
+        one leaf both pack correctly (mixed dtypes + a zero-size
+        leaf riding along)."""
+        tree = {
+            "a": jnp.asarray(rng.normal(size=(30,)), jnp.float32),
+            "z": jnp.zeros((0,), jnp.float32),
+            "b": jnp.asarray(rng.normal(size=(3, 3)), jnp.bfloat16),
+            "c": jnp.asarray(rng.normal(size=(25,)), jnp.float32),
+        }
+        spec = flat_spec(tree, 4, bucket_elems=8)
+        assert spec.n_buckets == spec.padded // spec.bucket_len > 1
+        parts = jnp.concatenate([
+            flat_pack_bucket(tree, spec, i) for i in range(spec.n_buckets)
+        ])
+        np.testing.assert_array_equal(
+            np.asarray(parts), np.asarray(flat_pack(tree, spec))
+        )
+
+
+class TestFlatSpecCache:
+    """flat_spec memoization (ISSUE 2 satellite): same layout hits,
+    distinct shard counts / dtypes / bucket sizes miss."""
+
+    def test_hits_and_misses(self, rng):
+        flat_spec_cache_clear()
+        tree = {"w": jnp.asarray(rng.normal(size=(8, 4)), jnp.float32),
+                "b": jnp.asarray(rng.normal(size=(5,)), jnp.float32)}
+        s1 = flat_spec(tree, 8)
+        assert flat_spec_cache_info() == {
+            "hits": 0, "misses": 1, "size": 1}
+        s2 = flat_spec(tree, 8)
+        assert s2 is s1                           # memoized object
+        assert flat_spec_cache_info()["hits"] == 1
+        # same structure, fresh arrays: still a hit (keyed on layout)
+        tree2 = jax.tree.map(lambda x: x + 1, tree)
+        assert flat_spec(tree2, 8) is s1
+        assert flat_spec_cache_info()["hits"] == 2
+        # distinct shard count, bucket size, dtype, leaf dtype: miss
+        assert flat_spec(tree, 4) is not s1
+        assert flat_spec(tree, 8, bucket_elems=16) is not s1
+        assert flat_spec(tree, 8, dtype=jnp.bfloat16) is not s1
+        tree_bf = jax.tree.map(
+            lambda x: x.astype(jnp.bfloat16), tree
+        )
+        assert flat_spec(tree_bf, 8) is not s1
+        info = flat_spec_cache_info()
+        assert info["misses"] == 5 and info["hits"] == 2
+
+    def test_distinct_shapes_miss(self, rng):
+        flat_spec_cache_clear()
+        a = {"w": jnp.zeros((8,), jnp.float32)}
+        b = {"w": jnp.zeros((9,), jnp.float32)}
+        assert flat_spec(a, 4) is not flat_spec(b, 4)
+        assert flat_spec_cache_info()["misses"] == 2
+
+
+class TestBucketedExchange:
+    """Bucketed overlap-scheduled exchange (ISSUE 2 tentpole): the
+    bucketed pipeline must be bitwise-equal to the monolithic path —
+    bucketing only changes the dependence structure XLA schedules,
+    never the math."""
+
+    TREE_SHAPES = {"w": (37, 5), "b": (11,)}
+
+    def _tree(self, rng):
+        return {k: jnp.asarray(rng.normal(size=s), jnp.float32)
+                for k, s in self.TREE_SHAPES.items()}
+
+    def _tree_of(self, flat):
+        return {"w": flat[:185].reshape(37, 5), "b": flat[185:196]}
+
+    @pytest.mark.parametrize("opt_name", ["momentum", "adam", "sgd"])
+    def test_bucketed_zero1_matches_monolithic(self, mesh8, rng, opt_name):
+        opt = opt_lib.get(opt_name)
+        tree = self._tree(rng)
+        gstack = jnp.asarray(rng.normal(size=(8, 196)), jnp.float32)
+
+        def run(spec):
+            st0 = opt.shard_state(spec.shard_len)
+
+            def z1(params, ostate, g, lr):
+                def upd(p_s, g_s, st):
+                    return opt.update(p_s, g_s, st, lr)
+
+                return scatter_update_gather(
+                    params, self._tree_of(g[0]), upd, DATA_AXIS,
+                    spec=spec, opt_state=ostate,
+                )
+
+            osp = jax.tree.map(
+                lambda x: P(DATA_AXIS) if jnp.ndim(x) else P(), st0
+            )
+            step = jax.jit(shard_map(
+                z1, mesh=mesh8,
+                in_specs=(P(), osp, P(DATA_AXIS), P()),
+                out_specs=(P(), osp),
+            ))
+            og = jax.tree.map(
+                lambda x: jnp.zeros((spec.padded,), x.dtype)
+                if jnp.ndim(x) else x, st0,
+            )
+            return step(tree, og, gstack, jnp.float32(0.1))
+
+        # 196 elems / 8 shards: bucket_elems=40 -> 5 buckets of 40
+        p_mono, _ = run(flat_spec(tree, 8))
+        spec_b = flat_spec(tree, 8, bucket_elems=40)
+        assert spec_b.n_buckets == 5
+        p_buck, o_buck = run(spec_b)
+        for k in tree:
+            np.testing.assert_array_equal(
+                np.asarray(p_mono[k]), np.asarray(p_buck[k])
+            )
+        if opt_name == "adam":
+            # per-bucket updates share ONE step-counter increment
+            assert int(o_buck["t"]) == 1
+
+    def test_bucketed_legacy_closure_matches(self, mesh8, rng):
+        """The 2-arg opt_update closure (no opt_state kwarg) still
+        runs the pipelined collectives with one full-shard update."""
+        opt = opt_lib.momentum()
+        tree = self._tree(rng)
+        gstack = jnp.asarray(rng.normal(size=(8, 196)), jnp.float32)
+
+        def run(spec):
+            st0 = opt.shard_state(spec.shard_len)
+
+            def z1(params, ostate, g, lr):
+                def upd(p_s, g_s):
+                    return opt.update(p_s, g_s, ostate, lr)
+
+                return scatter_update_gather(
+                    params, self._tree_of(g[0]), upd, DATA_AXIS,
+                    spec=spec,
+                )
+
+            osp = jax.tree.map(
+                lambda x: P(DATA_AXIS) if jnp.ndim(x) else P(), st0
+            )
+            step = jax.jit(shard_map(
+                z1, mesh=mesh8,
+                in_specs=(P(), osp, P(DATA_AXIS), P()),
+                out_specs=(P(), osp),
+            ))
+            og = jax.tree.map(
+                lambda x: jnp.zeros((spec.padded,), x.dtype)
+                if jnp.ndim(x) else x, st0,
+            )
+            return step(tree, og, gstack, jnp.float32(0.1))
+
+        p_mono, _ = run(flat_spec(tree, 8))
+        p_buck, _ = run(flat_spec(tree, 8, bucket_elems=40))
+        for k in tree:
+            np.testing.assert_array_equal(
+                np.asarray(p_mono[k]), np.asarray(p_buck[k])
+            )
+
+    @pytest.mark.parametrize("two_phase", [False, True])
+    def test_bucketed_allreduce_matches_per_leaf(
+        self, mesh8, rng, two_phase
+    ):
+        stacked, trees = _per_device_trees(rng)
+
+        def run(bucket_elems):
+            fn = shard_map(
+                lambda t: jax.tree.map(
+                    lambda x: x[None],
+                    allreduce_mean(
+                        jax.tree.map(lambda x: x[0], t), DATA_AXIS,
+                        two_phase=two_phase, bucket_elems=bucket_elems,
+                    ),
+                ),
+                mesh=mesh8,
+                in_specs=P(DATA_AXIS), out_specs=P(DATA_AXIS),
+            )
+            return jax.jit(fn)(stacked)
+
+        mono, buck = run(0), run(24)   # 101 elems -> several buckets
+        want = jax.tree.map(lambda *xs: np.mean(xs, axis=0), *trees)
+        for k in ("w", "b"):
+            np.testing.assert_allclose(
+                np.asarray(buck[k][0]), want[k], rtol=1e-5, atol=1e-6
+            )
+            np.testing.assert_array_equal(
+                np.asarray(mono[k]), np.asarray(buck[k])
+            )
+
+    def test_strategy_call_passes_bucket(self, mesh8, rng):
+        """ExchangeStrategy.__call__ bucket plumbing + bucket_elems
+        conversion from the MB knob."""
+        strat = get_strategy("asa32")
+        assert strat.bucket_elems(0) == 0
+        assert strat.bucket_elems(4) == 4 * 2**20 // 4
+        assert strat.bucket_elems(0.25) == 2**18 // 4
+        stacked, trees = _per_device_trees(rng)
+        fn = shard_map(
+            lambda t: jax.tree.map(
+                lambda x: x[None],
+                strat(jax.tree.map(lambda x: x[0], t), DATA_AXIS, 24),
+            ),
+            mesh=mesh8, in_specs=P(DATA_AXIS), out_specs=P(DATA_AXIS),
+        )
+        out = jax.jit(fn)(stacked)
+        want = jax.tree.map(lambda *xs: np.mean(xs, axis=0), *trees)
+        np.testing.assert_allclose(
+            np.asarray(out["w"][0]), want["w"], rtol=1e-5, atol=1e-6
+        )
+
+
+class TestBucketedTraining:
+    """End-to-end: exchange_bucket_mb > 0 must reproduce the
+    monolithic path's loss trajectory bitwise (ISSUE 2 acceptance) —
+    Llama (zero1 + asa32) fast at 25 steps, 50-step Llama + AlexNet
+    in the slow tier (same pattern as TestZero1Training)."""
+
+    LLAMA_CFG = dict(
+        dim=32, n_layers=2, n_heads=4, n_kv_heads=2, ffn_dim=64,
+        vocab=64, seq_len=16, batch_size=2, compute_dtype="float32",
+        n_epochs=1, seed=3, lr=1e-3,
+    )
+
+    def _llama_losses(self, strategy, bucket_mb, steps, devices):
+        from theanompi_tpu.models.llama import Llama
+        from theanompi_tpu.utils import Recorder
+
+        cfg = dict(self.LLAMA_CFG, exch_strategy=strategy,
+                   exchange_bucket_mb=bucket_mb, n_train=16 * steps)
+        m = Llama(cfg)
+        m.build_model(n_replicas=8)
+        m.compile_iter_fns(mesh=make_mesh(data=8, devices=devices))
+        if bucket_mb:
+            # the toy model must actually bucket, or the test is void
+            assert m._bucket_elems > 0
+        rec = Recorder(verbose=False)
+        for i in range(steps):
+            m.train_iter(i, rec)
+        rec.flush()
+        return np.asarray(rec.train_losses)
+
+    @pytest.mark.parametrize("strategy", ["zero1", "asa32"])
+    def test_llama_bucketed_matches_monolithic(self, devices8, strategy):
+        # ~22.6k params: 0.01 MiB buckets -> ~9 buckets
+        mono = self._llama_losses(strategy, 0, 25, devices8)
+        buck = self._llama_losses(strategy, 0.01, 25, devices8)
+        assert np.all(np.isfinite(mono))
+        np.testing.assert_array_equal(buck, mono)
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("strategy", ["zero1", "asa32"])
+    def test_llama_bucketed_matches_monolithic_50_steps(
+        self, devices8, strategy
+    ):
+        mono = self._llama_losses(strategy, 0, 50, devices8)
+        buck = self._llama_losses(strategy, 0.01, 50, devices8)
+        assert np.all(np.isfinite(mono))
+        np.testing.assert_array_equal(buck, mono)
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("strategy", ["zero1", "asa32"])
+    def test_alexnet_bucketed_matches_monolithic_50_steps(
+        self, devices8, strategy
+    ):
+        from theanompi_tpu.models.alex_net import AlexNet
+        from theanompi_tpu.utils import Recorder
+
+        losses = {}
+        for bmb in (0, 0.25):
+            cfg = dict(batch_size=2, crop=67, n_train=16 * 50, n_val=16,
+                       n_epochs=1, seed=5, exch_strategy=strategy,
+                       exchange_bucket_mb=bmb, lr=0.01)
+            m = AlexNet(cfg)
+            m.build_model(n_replicas=8)
+            m.compile_iter_fns(
+                mesh=make_mesh(data=8, devices=devices8)
+            )
+            if bmb:
+                assert m._bucket_elems > 0
+            rec = Recorder(verbose=False)
+            for i in range(50):
+                m.train_iter(i, rec)
+            rec.flush()
+            losses[bmb] = np.asarray(rec.train_losses)
+        assert np.all(np.isfinite(losses[0]))
+        if strategy == "zero1":
+            # both arms are reduce-scatter + all-gather over the same
+            # packed buffer — bucket order only permutes the internal
+            # layout, trajectories bitwise-equal (measured 0.0)
+            np.testing.assert_array_equal(losses[0.25], losses[0])
+        else:
+            # monolithic asa32 mixes the per-leaf psum FALLBACK
+            # (leading dims not divisible by 8) with true RS+AG,
+            # while the bucketed path is uniformly RS+AG — the two
+            # lowerings differ in reduction order at the ulp level,
+            # and AlexNet's bf16 compute amplifies that chaotically
+            # over 50 steps (measured max rel 5e-5).  Same bound
+            # family as PR 1's cross-strategy trajectory tests.
+            np.testing.assert_allclose(
+                losses[0.25], losses[0], rtol=1e-4
+            )
+
+    def test_zero1_bucket_layout_resume_guard(self, devices8, tmp_path):
+        """A zero1 optimizer checkpoint is tied to its bucket layout
+        (the flat shard order is bucket-major): resuming under a
+        DIFFERENT exchange_bucket_mb must refuse loudly in both load
+        orders; the same layout resumes fine."""
+        from theanompi_tpu.models.wresnet import WResNet
+        from theanompi_tpu.utils import Recorder
+
+        cfg = {"batch_size": 4, "depth": 10, "widen": 1,
+               "n_train": 32, "n_val": 16, "n_epochs": 1, "seed": 7,
+               "exchange_bucket_mb": 0.02}
+        mesh = make_mesh(data=8, devices=devices8)
+
+        def build(c):
+            m = WResNet(dict(c))
+            m.build_model(n_replicas=8)
+            m.compile_iter_fns(mesh=mesh, exch_strategy="zero1")
+            return m
+
+        m = build(cfg)
+        assert m._zero1_layout[1] > 0          # actually bucketed
+        m.save(str(tmp_path / "a"), Recorder(verbose=False))
+
+        # same layout: resumes
+        m2 = build(cfg)
+        assert m2.load(str(tmp_path / "a"), Recorder(verbose=False))
+
+        # the DANGEROUS case: a bucket size that divides the
+        # monolithic padded, so both layouts produce IDENTICAL flat
+        # shapes — only the stamped marker can tell them apart
+        # (differing-padded mismatches are already refused by the
+        # sharded-checkpoint shape check)
+        m_mono = build(dict(cfg, exchange_bucket_mb=0))
+        padded = m_mono._zero1_layout[0]
+        assert padded % 32 == 0                # 4 buckets, 8 shards
+        coincide_mb = padded * 4 / 4 / 2**20   # padded/4 elems, fp32
+        m5 = build(dict(cfg, exchange_bucket_mb=coincide_mb))
+        assert m5._zero1_layout == (padded, padded // 4)
+        m5.save(str(tmp_path / "b"), Recorder(verbose=False))
+
+        # compile-then-load (THE supported zero1 resume order) across
+        # layouts: load refuses despite the shapes matching exactly.
+        # (The load-then-compile order already fails structurally for
+        # sharded zero1 checkpoints — the restore prototype must be
+        # the compiled flat layout.)
+        with pytest.raises(ValueError, match="layout"):
+            m_mono.load(str(tmp_path / "b"), Recorder(verbose=False))
+
+        # the bucketed arm refuses the monolithic stamp symmetrically
+        m_mono2 = build(dict(cfg, exchange_bucket_mb=0))
+        m_mono2.save(str(tmp_path / "c"), Recorder(verbose=False))
+        m7 = build(dict(cfg, exchange_bucket_mb=coincide_mb))
+        with pytest.raises(ValueError, match="layout"):
+            m7.load(str(tmp_path / "c"), Recorder(verbose=False))
+
+    def test_worker_bucketed_summary(self, devices8):
+        """The BSP worker surfaces the knob and rejects bad values."""
+        from theanompi_tpu.workers import bsp_worker
+
+        TINY = {"batch_size": 4, "depth": 10, "widen": 1, "lr": 0.05,
+                "n_train": 32, "n_val": 16, "seed": 7, "n_epochs": 1,
+                "exchange_bucket_mb": 0.02}
+        res = bsp_worker.run(
+            devices=list(range(8)),
+            modelfile="theanompi_tpu.models.wresnet",
+            modelclass="WResNet",
+            config=TINY, verbose=False, exch_strategy="zero1",
+        )
+        assert res["exchange_bucket_mb"] == 0.02
+        with pytest.raises(ValueError, match="exchange_bucket_mb"):
+            bsp_worker.run(
+                devices=list(range(8)),
+                modelfile="theanompi_tpu.models.wresnet",
+                modelclass="WResNet",
+                config=dict(TINY, exchange_bucket_mb=-1),
+                verbose=False,
+            )
 
 
 class TestZero1Training:

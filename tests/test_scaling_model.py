@@ -141,6 +141,60 @@ def test_zero1_hbm_accounting():
     assert mb_z1 > mb_ar > 0
 
 
+def test_bucketed_overlap_predictor():
+    """ISSUE 2: the bucket-count / per-bucket-wire-time overlap model
+    (scaling_model.bucketed_overlap) — the analytical half of the
+    bucketed-vs-monolithic A/B."""
+    from theanompi_tpu.utils.scaling_model import bucketed_overlap
+
+    wire = 100e6          # ~100 MB of fp32 grads
+    step = 0.050
+    mono = bucketed_overlap(
+        wire_bytes=wire, n_chips=8, step_time_1chip=step,
+        bucket_bytes=0,
+    )
+    buck = bucketed_overlap(
+        wire_bytes=wire, n_chips=8, step_time_1chip=step,
+        bucket_bytes=4 * 2**20,
+    )
+    # monolithic = one bucket, fully exposed tail
+    assert mono["n_buckets"] == 1
+    assert mono["t_exposed_monolithic_ms"] == pytest.approx(
+        mono["t_exposed_bucketed_ms"]
+    )
+    assert buck["n_buckets"] == math.ceil(wire / (4 * 2**20))
+    # bucketing can only reduce the exposed tail, never grow it past
+    # the monolithic bound, and the floor is one bucket's wire time
+    assert (buck["t_exposed_bucketed_ms"]
+            <= buck["t_exposed_monolithic_ms"])
+    assert buck["overlap_win_ms"] >= 0.0
+    assert (buck["exposed_comm_frac_bucketed"]
+            <= buck["exposed_comm_frac_monolithic"])
+    # with a generous compute budget only the tail bucket is exposed
+    roomy = bucketed_overlap(
+        wire_bytes=wire, n_chips=8, step_time_1chip=10.0,
+        bucket_bytes=4 * 2**20,
+    )
+    per_bucket_ms = roomy["t_wire_ms"] / roomy["n_buckets"]
+    assert roomy["t_exposed_bucketed_ms"] == pytest.approx(
+        per_bucket_ms
+    )
+    # launch overhead: absurdly small buckets pay n_buckets * launch
+    # and the model says so (total wire GROWS as buckets shrink)
+    tiny = bucketed_overlap(
+        wire_bytes=wire, n_chips=8, step_time_1chip=step,
+        bucket_bytes=2**14,
+    )
+    assert tiny["t_wire_ms"] > buck["t_wire_ms"]
+    # degenerate inputs: single chip / zero wire are all-zero rows
+    z = bucketed_overlap(
+        wire_bytes=wire, n_chips=1, step_time_1chip=step,
+        bucket_bytes=4 * 2**20,
+    )
+    assert z["t_exposed_bucketed_ms"] == 0.0
+    assert z["exposed_comm_frac_monolithic"] == 0.0
+
+
 def test_llama8b_step_time_prediction():
     """Predicted 8B step time at the r3 measured proxy MFU: the
     PODS.md number a future pod run is checked against."""

@@ -88,6 +88,11 @@ class TestOverlapMath:
         assert rep["hidden_comm_s"] == pytest.approx(800 * ps)
         assert rep["exposed_comm_frac"] == pytest.approx(500 / 1500)
         assert rep["comm_frac"] == pytest.approx(1300 / 1500)
+        # the explicit overlapped-vs-exposed split (bucketed-exchange
+        # A/B surface): overlapped == hidden, frac is of COLLECTIVE
+        # time (800 of the 1300 collective ps ran under compute)
+        assert rep["overlapped_comm_s"] == pytest.approx(800 * ps)
+        assert rep["overlapped_comm_frac"] == pytest.approx(800 / 1300)
         assert rep["top_collectives"][0][0] == "all-reduce.1"
 
     def test_collective_stall_on_one_core_is_exposed(self, tmp_path):
@@ -111,6 +116,27 @@ class TestOverlapMath:
         rep = comm_report(str(d))
         assert rep["collective_s"] == 0.0
         assert rep["exposed_comm_frac"] == 0.0
+        # no collective time: the overlapped share is defined as 0
+        assert rep["overlapped_comm_frac"] == 0.0
+
+    def test_fully_serialized_tail_vs_fully_hidden(self, tmp_path):
+        """The two poles the bucketed A/B distinguishes: a collective
+        AFTER all compute (the monolithic exchange tail) is 0%
+        overlapped; one fully UNDER compute is 100%."""
+        tail = _write_trace(tmp_path / "tail", [[
+            ("fusion.1", 0, 1000),
+            ("all-reduce.1", 1000, 500),
+        ]])
+        rep = comm_report(str(tail))
+        assert rep["overlapped_comm_frac"] == 0.0
+        assert rep["exposed_comm_s"] == pytest.approx(500e-12)
+        hidden = _write_trace(tmp_path / "hidden", [[
+            ("fusion.1", 0, 1000),
+            ("all-reduce.1", 200, 500),
+        ]])
+        rep = comm_report(str(hidden))
+        assert rep["overlapped_comm_frac"] == 1.0
+        assert rep["exposed_comm_s"] == 0.0
 
     def test_no_trace_raises(self, tmp_path):
         with pytest.raises(FileNotFoundError):

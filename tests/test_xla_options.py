@@ -1,0 +1,96 @@
+"""Per-jit XLA compiler-option resolution (utils/xla_options):
+config/env PER-KEY merge (ISSUE 2 satellite — env knobs must survive
+a config that carries its own options) + the overlap preset the
+bucketed exchange feeds to the scheduler."""
+
+import pytest
+
+from theanompi_tpu.utils.xla_options import (
+    overlap_preset,
+    xla_compiler_options,
+)
+
+
+class TestMerge:
+    def test_unset_is_none(self, monkeypatch):
+        monkeypatch.delenv("TM_XLA_OPTIONS", raising=False)
+        assert xla_compiler_options({}) is None
+        assert xla_compiler_options(None) is None
+
+    def test_env_only(self, monkeypatch):
+        monkeypatch.setenv("TM_XLA_OPTIONS", "xla_tpu_foo=1, xla_bar=b")
+        assert xla_compiler_options({}) == {
+            "xla_tpu_foo": "1", "xla_bar": "b"
+        }
+
+    def test_config_only(self, monkeypatch):
+        monkeypatch.delenv("TM_XLA_OPTIONS", raising=False)
+        assert xla_compiler_options(
+            {"xla_options": "xla_tpu_foo=2"}
+        ) == {"xla_tpu_foo": "2"}
+        assert xla_compiler_options(
+            {"xla_options": {"--xla_tpu_foo": 3}}
+        ) == {"xla_tpu_foo": 3}
+
+    def test_config_wins_per_key_env_keys_survive(self, monkeypatch):
+        """THE satellite case: one env knob + a config options dict —
+        pre-fix the whole env dict was silently discarded."""
+        monkeypatch.setenv(
+            "TM_XLA_OPTIONS", "xla_tpu_sweep=A,xla_shared=env"
+        )
+        out = xla_compiler_options(
+            {"xla_options": {"xla_shared": "cfg", "xla_cfg_only": "c"}}
+        )
+        assert out == {
+            "xla_tpu_sweep": "A",        # env key survives the merge
+            "xla_shared": "cfg",         # config wins per key
+            "xla_cfg_only": "c",
+        }
+
+    def test_env_overrides_nothing_when_config_sets_same_key(
+        self, monkeypatch
+    ):
+        """The other precedence direction: a config string form also
+        wins per key over env."""
+        monkeypatch.setenv("TM_XLA_OPTIONS", "xla_shared=env")
+        out = xla_compiler_options({"xla_options": "xla_shared=cfg"})
+        assert out == {"xla_shared": "cfg"}
+
+    def test_bad_env_entry_raises(self, monkeypatch):
+        monkeypatch.setenv("TM_XLA_OPTIONS", "not-a-kv")
+        with pytest.raises(ValueError, match="not-a-kv"):
+            xla_compiler_options({})
+
+
+class TestOverlapPreset:
+    def test_preset_keys(self):
+        p = overlap_preset()
+        assert p["xla_tpu_enable_latency_hiding_scheduler"] == "true"
+        # every key is a TPU-compiler option (the caller gates on the
+        # mesh platform; a non-tpu key here would leak past that gate)
+        assert all(k.startswith("xla_tpu_") for k in p)
+
+    def test_overlap_lowest_precedence(self, monkeypatch):
+        monkeypatch.setenv(
+            "TM_XLA_OPTIONS",
+            "xla_tpu_enable_latency_hiding_scheduler=false",
+        )
+        out = xla_compiler_options({}, overlap=True)
+        # env beats the preset...
+        assert out["xla_tpu_enable_latency_hiding_scheduler"] == "false"
+        # ...and config beats env
+        out = xla_compiler_options(
+            {"xla_options": {
+                "xla_tpu_enable_latency_hiding_scheduler": "true"
+            }},
+            overlap=True,
+        )
+        assert out["xla_tpu_enable_latency_hiding_scheduler"] == "true"
+        # untouched preset keys ride along
+        assert (
+            out["xla_tpu_enable_async_collective_fusion"] == "true"
+        )
+
+    def test_overlap_off_no_preset(self, monkeypatch):
+        monkeypatch.delenv("TM_XLA_OPTIONS", raising=False)
+        assert xla_compiler_options({}, overlap=False) is None

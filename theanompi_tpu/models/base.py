@@ -18,6 +18,7 @@ as arguments.
 
 from __future__ import annotations
 
+import math
 import time
 from functools import partial
 from typing import Any, Optional
@@ -163,6 +164,14 @@ class TMModel:
         meta = {"epoch": self.epoch, "lr": self.current_lr}
         if recorder is not None:
             meta["recorder"] = recorder.state_dict()
+        # zero1 optimizer shards are flat buffers whose INTERNAL order
+        # depends on the bucket layout (bucket-major when bucketed) —
+        # stamp it so a resume under a different exchange_bucket_mb
+        # refuses instead of silently pairing m/v rows with the wrong
+        # params (the shapes alone can coincide across layouts)
+        z_layout = getattr(self, "_zero1_layout", None)
+        if z_layout is not None:
+            meta["zero1_layout"] = list(z_layout)
         trees = self.checkpoint_trees()
         if self._checkpoint_format(trees) == "sharded":
             save_sharded_checkpoint(directory, self.epoch, trees, meta)
@@ -179,6 +188,27 @@ class TMModel:
             )
         else:
             trees, meta = load_checkpoint(path, self.checkpoint_trees())
+        # bucket-layout guard BEFORE any state is attached: when this
+        # model already compiled a zero1 step, the restored flat
+        # optimizer shard is only meaningful under the layout it was
+        # saved with (missing marker = a pre-bucketing monolithic
+        # checkpoint)
+        cur = getattr(self, "_zero1_layout", None)
+        if cur is not None and "opt_state" in trees:
+            saved = meta.get("zero1_layout")
+            saved = tuple(saved) if saved is not None else (cur[0], 0)
+            if saved != tuple(cur):
+                raise ValueError(
+                    f"zero1 optimizer checkpoint layout {saved} "
+                    f"(padded, bucket_len) does not match the "
+                    f"compiled exchange layout {tuple(cur)} — the "
+                    f"flat shard order is bucket-dependent, so "
+                    f"resuming would silently pair adam/momentum "
+                    f"rows with the wrong parameters; set "
+                    f"exchange_bucket_mb to the value the checkpoint "
+                    f"was trained with"
+                )
+        self._restored_zero1_layout = meta.get("zero1_layout")
         for group, tree in trees.items():
             setattr(self, group, tree)
         # compile_iter_fns consults this: compiling with a zero1
@@ -271,8 +301,33 @@ class ClassifierModel(TMModel):
         # shard → all-gather updated params).  Per-chip optimizer HBM
         # drops ~1/N; the wire moves the same bytes as the two-phase
         # allreduce.
+        # bucketed exchange (DDP-style overlap, Li et al. 2020):
+        # ``exchange_bucket_mb`` splits the grad/param exchange into
+        # fixed buckets whose collectives pipeline against compute;
+        # 0 keeps the monolithic exchange.  Default ~4 MiB — tiny
+        # models degrade to monolithic inside flat_spec.
+        from theanompi_tpu.parallel import resolve_bucket_mb
+        from theanompi_tpu.parallel.exchange import flat_layout
+
+        bucket_elems = strat.bucket_elems(resolve_bucket_mb(self.config))
+        self._bucket_elems = bucket_elems
+
         n_dp = self.mesh.shape[DATA_AXIS]
-        zspec = flat_spec(self.params, n_dp) if strat.zero1 else None
+        zspec = (
+            flat_spec(self.params, n_dp, bucket_elems=bucket_elems)
+            if strat.zero1 else None
+        )
+        # the layout the knob ACTUALLY produced (tiny models degrade
+        # to monolithic inside flat_layout) — gates the overlap
+        # preset and stamps zero1 checkpoints (a resumed bucket-major
+        # optimizer shard is only valid under the same bucket_len)
+        n_elems = sum(
+            math.prod(jnp.shape(l)) for l in jax.tree.leaves(self.params)
+        )
+        eff_bucket_len = flat_layout(n_elems, n_dp, bucket_elems)[1]
+        self._zero1_layout = (
+            (zspec.padded, zspec.bucket_len) if strat.zero1 else None
+        )
         if strat.zero1:
             shard_state = optimizer.shard_state(zspec.shard_len)
             if getattr(self, "_restored_opt", False):
@@ -281,13 +336,18 @@ class ClassifierModel(TMModel):
                 # preserved; anything else would be silently zeroed
                 # below — refuse instead (compile-then-load is the
                 # supported resume order; cross-strategy resume is not)
+                saved = getattr(self, "_restored_zero1_layout", None)
+                saved = (
+                    tuple(saved) if saved is not None
+                    else (zspec.padded, 0)   # pre-bucketing: monolithic
+                )
                 zero1_layout = jax.tree.structure(
                     self.opt_state
                 ) == jax.tree.structure(shard_state) and all(
                     jnp.shape(l) == (zspec.padded,)
                     for l in jax.tree.leaves(self.opt_state)
                     if jnp.ndim(l)
-                )
+                ) and saved == (zspec.padded, zspec.bucket_len)
                 if not zero1_layout:
                     raise ValueError(
                         "compile_iter_fns(exch_strategy='zero1') "
@@ -343,19 +403,22 @@ class ClassifierModel(TMModel):
                 # ZeRO-1 exchange: reduce-scatter grads, update the
                 # optimizer on this device's 1/N flat shard, all-gather
                 # the updated params (same wire bytes as two-phase
-                # allreduce, optimizer HBM /N)
-                def opt_upd(p_shard, g_shard):
-                    return optimizer.update(p_shard, g_shard, opt_state, lr)
+                # allreduce, optimizer HBM /N).  With buckets the
+                # three phases pipeline per bucket (state sliced by
+                # scatter_update_gather — hence the 3-arg closure).
+                def opt_upd(p_shard, g_shard, state):
+                    return optimizer.update(p_shard, g_shard, state, lr)
 
                 params, opt_state = scatter_update_gather(
                     params, grads, opt_upd, DATA_AXIS,
                     wire_dtype=strat.wire_dtype, spec=zspec,
+                    opt_state=opt_state,
                 )
             else:
                 # THE exchange: BSP allreduce folded into the step
                 # (reference: BSP_Exchanger.exchange between train
-                # iters).
-                grads = strat(grads, DATA_AXIS)
+                # iters), bucketed when exchange_bucket_mb says so.
+                grads = strat(grads, DATA_AXIS, bucket_elems)
                 params, opt_state = optimizer.update(
                     params, grads, opt_state, lr
                 )
@@ -373,8 +436,17 @@ class ClassifierModel(TMModel):
 
         rep = P()
         dp = P(DATA_AXIS)
-        # TPU compiler knobs (remote-compile safe; utils/xla_options)
-        self._compiler_options = xla_compiler_options(self.config)
+        # TPU compiler knobs (remote-compile safe; utils/xla_options).
+        # A bucketed exchange additionally feeds the overlap preset
+        # (async collectives + latency-hiding scheduler) — TPU meshes
+        # only (the CPU client rejects unknown xla_tpu_* options) and
+        # only when the layout actually bucketed: a degraded-to-
+        # monolithic model must keep compiler_options None, or the
+        # jit call churns the compile-cache key for nothing.
+        is_tpu = self.mesh.devices.flat[0].platform == "tpu"
+        self._compiler_options = xla_compiler_options(
+            self.config, overlap=bool(eff_bucket_len) and is_tpu
+        )
         self._train_step = jax.jit(
             jax.shard_map(
                 shard_train,

@@ -607,6 +607,14 @@ class Llama(TMModel):
         strat = get_strategy(
             exch_strategy or self.config.get("exch_strategy", "ici32")
         )
+        # bucketed DP exchange (exchange_bucket_mb, default ~4 MiB;
+        # 0 = monolithic): per-bucket collectives pipeline against
+        # compute — see parallel/exchange.  Small models degrade to
+        # the monolithic path inside flat_spec.
+        from theanompi_tpu.parallel import resolve_bucket_mb
+
+        bucket_elems = strat.bucket_elems(resolve_bucket_mb(self.config))
+        self._bucket_elems = bucket_elems
         if mesh is None:
             mesh = make_mesh(
                 model=self.tp, seq=self.sp, pipe=self.pp, expert=self.ep
@@ -668,6 +676,41 @@ class Llama(TMModel):
         zero1 = strat.zero1
         z_shard_len = None
         z_state_proto = None
+        # LOCAL (per-device) parameter-pack size + the bucket layout
+        # it actually produces (flat_layout is THE shared rule: the
+        # in-step flat_spec, the zero1 state sizing, and the overlap
+        # gate below must all agree; tiny models degrade to
+        # monolithic).  Shape-only eval, no compute.
+        from theanompi_tpu.parallel.exchange import flat_layout
+
+        shapes = jax.eval_shape(
+            self._init_full_params, jax.random.PRNGKey(0)
+        )
+
+        def _local_elems(leaf, spec):
+            dims = list(leaf.shape)
+            for i, ax in enumerate(tuple(spec)):
+                if ax is None:
+                    continue
+                for a in (ax if isinstance(ax, (tuple, list))
+                          else (ax,)):
+                    dims[i] //= mesh.shape[a]
+            return math.prod(dims)
+
+        local_size = sum(
+            _local_elems(l, s)
+            for l, s in zip(
+                jax.tree.leaves(shapes),
+                jax.tree.leaves(
+                    specs, is_leaf=lambda s: isinstance(s, P)
+                ),
+            )
+        )
+        n_dp = dp_replicas(mesh)
+        z_padded, z_bucket_len = flat_layout(
+            local_size, n_dp, bucket_elems
+        )
+        self._zero1_layout = (z_padded, z_bucket_len) if zero1 else None
         if zero1:
             if self.n_experts:
                 raise NotImplementedError(
@@ -677,31 +720,7 @@ class Llama(TMModel):
                     "leaves exchange over (expert, data) — two "
                     "separate shard groups"
                 )
-            shapes = jax.eval_shape(
-                self._init_full_params, jax.random.PRNGKey(0)
-            )
-
-            def _local_elems(leaf, spec):
-                dims = list(leaf.shape)
-                for i, ax in enumerate(tuple(spec)):
-                    if ax is None:
-                        continue
-                    for a in (ax if isinstance(ax, (tuple, list))
-                              else (ax,)):
-                        dims[i] //= mesh.shape[a]
-                return math.prod(dims)
-
-            local_size = sum(
-                _local_elems(l, s)
-                for l, s in zip(
-                    jax.tree.leaves(shapes),
-                    jax.tree.leaves(
-                        specs, is_leaf=lambda s: isinstance(s, P)
-                    ),
-                )
-            )
-            n_dp = dp_replicas(mesh)
-            z_shard_len = -(-local_size // n_dp)
+            z_shard_len = z_padded // n_dp
             z_flat_axes = tuple(
                 a for a in (PIPE_AXIS, EXPERT_AXIS, DATA_AXIS,
                             MODEL_AXIS)
@@ -835,7 +854,11 @@ class Llama(TMModel):
                 # already summed the ep group's token cotangents at
                 # each owner, so the global mean over e*d replicas is
                 # (mean over data) / ep; every other leaf averages
-                # over the full (expert, data) replica set
+                # over the full (expert, data) replica set.  The MoE
+                # exchange stays per-leaf/unbucketed: expert and
+                # dense leaves reduce over DIFFERENT axis sets, so
+                # one flat bucket buffer cannot span both groups
+                # (same split that keeps MoE+zero1 NotImplementedError)
                 def exch(g, is_exp):
                     if is_exp:
                         g = strat(g, DATA_AXIS)
@@ -852,19 +875,23 @@ class Llama(TMModel):
                 # device's flat 1/N shard (opt_state IS that shard —
                 # in_specs slice it), all-gather the updated params.
                 # Same wire bytes as the two-phase allreduce; the
-                # replicated fp32 m/v never exist.
-                def opt_upd(p_shard, g_shard):
+                # replicated fp32 m/v never exist.  With buckets the
+                # exchange pipelines per bucket (opt_state sliced
+                # inside scatter_update_gather — 3-arg closure).
+                def opt_upd(p_shard, g_shard, state):
                     return optimizer.update(
-                        p_shard, g_shard, opt_state, lr
+                        p_shard, g_shard, state, lr
                     )
 
                 params, new_opt = scatter_update_gather(
                     params, grads, opt_upd, dp_spec,
                     wire_dtype=strat.wire_dtype,
+                    opt_state=opt_state,
+                    bucket_elems=bucket_elems,
                 )
                 opt_state = new_opt
             else:
-                grads = strat(grads, dp_spec)
+                grads = strat(grads, dp_spec, bucket_elems)
                 params, opt_state = optimizer.update(
                     params, grads, opt_state, lr
                 )
@@ -876,10 +903,20 @@ class Llama(TMModel):
             logits = self._forward(params, x)
             return self._metrics(logits, y, top5=True)
 
-        # TPU compiler knobs (remote-compile safe; utils/xla_options)
+        # TPU compiler knobs (remote-compile safe; utils/xla_options).
+        # A bucketed exchange also feeds the overlap preset (async
+        # collectives + latency-hiding scheduler) — TPU meshes only
+        # (the CPU client rejects unknown xla_tpu_* options) and only
+        # when the layout ACTUALLY bucketed (degraded-to-monolithic
+        # models keep compiler_options None so compile-cache keys
+        # don't churn; the MoE per-leaf exchange never buckets).
         from theanompi_tpu.utils.xla_options import xla_compiler_options
 
-        self._compiler_options = xla_compiler_options(self.config)
+        is_tpu = mesh.devices.flat[0].platform == "tpu"
+        self._compiler_options = xla_compiler_options(
+            self.config,
+            overlap=bool(z_bucket_len) and not self.n_experts and is_tpu,
+        )
         self._train_step = jax.jit(
             jax.shard_map(
                 step,

@@ -29,7 +29,10 @@ from theanompi_tpu.parallel.exchange import (
     FlatSpec,
     allreduce_mean,
     flat_pack,
+    flat_pack_bucket,
     flat_spec,
+    flat_spec_cache_clear,
+    flat_spec_cache_info,
     flat_unpack,
     scatter_update_gather,
     elastic_pair_update,
@@ -48,8 +51,10 @@ from theanompi_tpu.parallel.moe import (
     router_topk,
 )
 from theanompi_tpu.parallel.strategies import (
+    DEFAULT_BUCKET_MB,
     ExchangeStrategy,
     get_strategy,
+    resolve_bucket_mb,
     STRATEGIES,
 )
 
@@ -71,7 +76,10 @@ __all__ = [
     "FlatSpec",
     "allreduce_mean",
     "flat_pack",
+    "flat_pack_bucket",
     "flat_spec",
+    "flat_spec_cache_clear",
+    "flat_spec_cache_info",
     "flat_unpack",
     "scatter_update_gather",
     "elastic_pair_update",
@@ -81,8 +89,10 @@ __all__ = [
     "gossip_merge",
     "gossip_matrix_round",
     "replica_consistency_delta",
+    "DEFAULT_BUCKET_MB",
     "ExchangeStrategy",
     "get_strategy",
+    "resolve_bucket_mb",
     "STRATEGIES",
     "aux_moments",
     "load_balance_loss",

@@ -48,6 +48,7 @@ def allreduce_mean(
     *,
     wire_dtype=None,
     two_phase: bool = False,
+    bucket_elems: int = 0,
 ) -> PyTree:
     """Mean-allreduce a pytree over ``axis_name``.
 
@@ -65,11 +66,38 @@ def allreduce_mean(
     spans their product (the MoE case: non-expert grads average over
     ``(expert, data)`` while expert-sharded grads average over
     ``data`` alone).
+
+    ``bucket_elems > 0`` packs the tree into one flat buffer and
+    exchanges it as fixed-size BUCKETS (DDP-style, Li et al. 2020):
+    each bucket's collective depends only on the leaves it covers, so
+    XLA's latency-hiding scheduler can dispatch bucket *i*'s wire time
+    under bucket *i±1*'s (and the producing backward's) compute instead
+    of serializing one monolithic tail.  Small leaves coalesce (fewer
+    per-collective launches), large buffers split (earlier first
+    dispatch).  When the tree fits in a single bucket the per-leaf
+    monolithic path below runs unchanged.
     """
     axes = axis_name if isinstance(axis_name, tuple) else (axis_name,)
     n = 1
     for a in axes:
         n *= lax.axis_size(a)
+
+    if bucket_elems:
+        spec = flat_spec(tree, n, bucket_elems=bucket_elems)
+        if spec.n_buckets > 1:
+            parts = []
+            for i in range(spec.n_buckets):
+                b = flat_pack_bucket(tree, spec, i)
+                w = b if wire_dtype is None else b.astype(wire_dtype)
+                if two_phase:
+                    part = lax.psum_scatter(
+                        w, axes, scatter_dimension=0, tiled=True
+                    )
+                    w = lax.all_gather(part, axes, axis=0, tiled=True)
+                else:
+                    w = lax.psum(w, axes)
+                parts.append((w / n).astype(spec.dtype))
+            return flat_unpack(jnp.concatenate(parts), spec)
 
     def one(x):
         orig = x.dtype
@@ -107,6 +135,12 @@ class FlatSpec:
     Built once at trace time (`flat_spec`); `flat_pack`/`flat_unpack`
     are pure jittable functions over it.  ``padded`` is ``size``
     rounded up so the buffer shards evenly over ``n_shards`` devices.
+
+    ``bucket_len > 0`` additionally tiles the buffer into equal
+    buckets of that many elements (each a multiple of ``n_shards``,
+    so every bucket reduce-scatters evenly); ``padded`` is then
+    rounded up to a whole bucket count.  ``bucket_len == 0`` is the
+    monolithic layout.
     """
 
     treedef: Any = field(repr=False)
@@ -114,32 +148,114 @@ class FlatSpec:
     dtypes: tuple
     dtype: Any            # buffer dtype (the optimizer's master width)
     size: int             # live elements
-    padded: int           # size rounded up to n_shards
+    padded: int           # size rounded up to n_shards (and buckets)
     n_shards: int
+    bucket_len: int = 0   # elements per bucket; 0 = monolithic
 
     @property
     def shard_len(self) -> int:
         return self.padded // self.n_shards
 
+    @property
+    def n_buckets(self) -> int:
+        return self.padded // self.bucket_len if self.bucket_len else 1
 
-def flat_spec(tree: PyTree, n_shards: int, dtype=None) -> FlatSpec:
+    @property
+    def bucket_shard_len(self) -> int:
+        """Per-device elements of ONE bucket's reduce-scatter shard."""
+        return (self.bucket_len if self.bucket_len else
+                self.padded) // self.n_shards
+
+
+# flat_spec memo: the spec is pure static layout, so rebuilding it per
+# trace (the zero1 plain-step, device-cache, and scan paths each
+# retrace the step body) is wasted flatten/shape work — and, worse,
+# per-compile treedef churn.  Keyed on everything that shapes the
+# layout; distinct shard counts / dtypes / bucket sizes miss.
+_FLAT_SPEC_CACHE: dict = {}
+_FLAT_SPEC_STATS = {"hits": 0, "misses": 0}
+
+
+def flat_spec_cache_info() -> dict:
+    """(hits, misses, size) of the ``flat_spec`` memo — test surface."""
+    return dict(_FLAT_SPEC_STATS, size=len(_FLAT_SPEC_CACHE))
+
+
+def flat_spec_cache_clear() -> None:
+    _FLAT_SPEC_CACHE.clear()
+    _FLAT_SPEC_STATS.update(hits=0, misses=0)
+
+
+# HLO-size guard: the bucketed pipeline is an UNROLLED loop (each
+# bucket must be its own HLO chain, depending only on its own leaves —
+# a lax.scan body would have to dynamic-slice the FULL packed buffer,
+# making every iteration depend on every gradient and killing the
+# backward overlap that is the point).  Unrolling is linear in bucket
+# count, so the count is capped: past the cap the bucket size grows
+# instead.  64 buckets is pipeline-depth plenty; it bounds trace and
+# compile cost at flagship scale (a 4 GB gradient pack at the 4 MiB
+# default would otherwise unroll ~1000 bodies).
+MAX_EXCHANGE_BUCKETS = 64
+
+
+def flat_layout(size: int, n_shards: int,
+                bucket_elems: int = 0) -> tuple[int, int]:
+    """``(padded, bucket_len)`` of a ``size``-element buffer sharded
+    ``n_shards`` ways with target ``bucket_elems`` per bucket — THE
+    layout rule, shared by ``flat_spec`` and the models' shard-shaped
+    optimizer-state sizing so both always agree.  ``bucket_len == 0``
+    means monolithic (requested bucket 0, or one bucket would cover
+    the buffer).  The bucket count is capped at
+    ``MAX_EXCHANGE_BUCKETS`` by growing the bucket size."""
+    padded = -(-size // n_shards) * n_shards
+    if bucket_elems <= 0 or not size:
+        return padded, 0
+    min_elems = -(-size // MAX_EXCHANGE_BUCKETS)
+    bucket_len = -(-max(int(bucket_elems), min_elems) // n_shards) * n_shards
+    if bucket_len >= padded:
+        return padded, 0              # one bucket = the monolithic path
+    return -(-size // bucket_len) * bucket_len, bucket_len
+
+
+def flat_spec(tree: PyTree, n_shards: int, dtype=None,
+              *, bucket_elems: int = 0) -> FlatSpec:
     """Layout for packing ``tree`` into one buffer sharded ``n`` ways.
 
     ``dtype``: buffer dtype; default is the common leaf dtype (fp32
-    when leaves disagree — the optimizer master width)."""
+    when leaves disagree — the optimizer master width).
+
+    ``bucket_elems``: target bucket size in ELEMENTS (callers convert
+    from ``exchange_bucket_mb``); rounded up to a multiple of
+    ``n_shards``.  When one bucket would cover the whole buffer the
+    spec degrades to the monolithic layout (``bucket_len == 0``), so
+    tiny models never pay bucketing overhead.
+
+    Memoized on (treedef, shapes, dtypes, n_shards, dtype,
+    bucket_elems) — see ``flat_spec_cache_info``.
+    """
     leaves, treedef = jax.tree.flatten(tree)
     shapes = tuple(tuple(jnp.shape(x)) for x in leaves)
     dtypes = tuple(jnp.asarray(x).dtype if not hasattr(x, "dtype")
                    else x.dtype for x in leaves)
+    key = (treedef, shapes, dtypes, int(n_shards),
+           None if dtype is None else jnp.dtype(dtype),
+           int(bucket_elems))
+    hit = _FLAT_SPEC_CACHE.get(key)
+    if hit is not None:
+        _FLAT_SPEC_STATS["hits"] += 1
+        return hit
+    _FLAT_SPEC_STATS["misses"] += 1
     if dtype is None:
         dtype = dtypes[0] if len(set(dtypes)) == 1 else jnp.float32
     size = sum(math.prod(s) for s in shapes)
-    padded = -(-size // n_shards) * n_shards
-    return FlatSpec(
+    padded, bucket_len = flat_layout(size, n_shards, bucket_elems)
+    spec = FlatSpec(
         treedef=treedef, shapes=shapes, dtypes=dtypes,
         dtype=jnp.dtype(dtype), size=size, padded=padded,
-        n_shards=n_shards,
+        n_shards=n_shards, bucket_len=bucket_len,
     )
+    _FLAT_SPEC_CACHE[key] = spec
+    return spec
 
 
 def flat_pack(tree: PyTree, spec: FlatSpec) -> jnp.ndarray:
@@ -148,6 +264,32 @@ def flat_pack(tree: PyTree, spec: FlatSpec) -> jnp.ndarray:
     parts = [jnp.ravel(x).astype(spec.dtype) for x in leaves]
     if spec.padded > spec.size:
         parts.append(jnp.zeros((spec.padded - spec.size,), spec.dtype))
+    return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+
+
+def flat_pack_bucket(tree: PyTree, spec: FlatSpec, i: int) -> jnp.ndarray:
+    """Bucket ``i`` of the packed buffer (``[spec.bucket_len]``),
+    built ONLY from the leaves overlapping it — so in the lowered HLO
+    a bucket's collective depends on just those leaves' producers, and
+    the scheduler can dispatch it while later leaves' gradients are
+    still being computed (the DDP-bucketing dependence structure)."""
+    if spec.bucket_len == 0:
+        assert i == 0
+        return flat_pack(tree, spec)
+    leaves = jax.tree.leaves(tree)
+    lo, hi = i * spec.bucket_len, (i + 1) * spec.bucket_len
+    parts, off, live = [], 0, 0
+    for x, shape in zip(leaves, spec.shapes):
+        n = math.prod(shape)
+        s, e = max(lo, off), min(hi, off + n)
+        if e > s:
+            flat = jnp.ravel(x).astype(spec.dtype)
+            parts.append(flat if (s == off and e == off + n)
+                         else lax.slice_in_dim(flat, s - off, e - off))
+            live += e - s
+        off += n
+    if live < spec.bucket_len:                 # tail bucket: zero pad
+        parts.append(jnp.zeros((spec.bucket_len - live,), spec.dtype))
     return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
 
 
@@ -182,6 +324,33 @@ def _pvary(x, axes: tuple):
     return lax.pcast(x, need, to="varying") if need else x
 
 
+def _slice_shard_state(opt_state: Any, spec: FlatSpec, i: int) -> Any:
+    """Bucket ``i``'s rows of a shard-shaped optimizer state: flat
+    ``[shard_len]`` leaves slice to ``[bucket_shard_len]``; scalar
+    leaves (adam's step counter) pass through whole."""
+    bs = spec.bucket_shard_len
+
+    def one(x):
+        if jnp.ndim(x) and jnp.shape(x)[0] == spec.shard_len:
+            return lax.slice_in_dim(x, i * bs, (i + 1) * bs)
+        return x
+
+    return jax.tree.map(one, opt_state)
+
+
+def _concat_shard_state(opt_state: Any, parts: list, spec: FlatSpec) -> Any:
+    """Inverse of ``_slice_shard_state``: reassemble per-bucket aux
+    states into the full shard layout.  Scalar leaves are identical
+    across buckets by construction (each bucket's update computed
+    them from the same replicated input) — the first is kept."""
+    def one(orig, *xs):
+        if jnp.ndim(orig) and jnp.shape(orig)[0] == spec.shard_len:
+            return jnp.concatenate(xs)
+        return xs[0]
+
+    return jax.tree.map(one, opt_state, *parts)
+
+
 def scatter_update_gather(
     params: PyTree,
     grads: PyTree,
@@ -190,6 +359,8 @@ def scatter_update_gather(
     *,
     wire_dtype=None,
     spec: FlatSpec | None = None,
+    opt_state: Any = None,
+    bucket_elems: int = 0,
 ) -> tuple[PyTree, Any]:
     """ZeRO-1 exchange + update, inside ``shard_map``.
 
@@ -208,6 +379,28 @@ def scatter_update_gather(
     in the master dtype — a bf16 gather would truncate the master
     weights and break equivalence with the allreduce path.
 
+    **Bucketed overlap schedule** (``spec.n_buckets > 1``, built via
+    ``flat_spec(..., bucket_elems=...)`` or the ``bucket_elems``
+    kwarg): the three phases run as a software pipeline over fixed
+    buckets instead of one monolithic tail.  Each bucket's
+    reduce-scatter depends only on the leaves it covers (see
+    ``flat_pack_bucket``), its optimizer update only on its own
+    grad/param/state rows, and its all-gather only on its own updated
+    shard — so with async collectives + the latency-hiding scheduler
+    (``utils.xla_options.overlap_preset``) bucket *i*'s wire time
+    dispatches under bucket *i±1*'s pack/update compute and under the
+    tail of the producing backward, instead of serializing after it.
+    The math is elementwise-identical to the monolithic path (bucket
+    order only permutes the INTERNAL flat layout of the optimizer
+    shard; unpacked params are bit-equal).
+
+    ``opt_state``: the (shard-shaped) optimizer state pytree.  When
+    given, ``opt_update`` is called as ``opt_update(p_shard, g_shard,
+    state)`` and the bucketed path slices the state per bucket — the
+    per-bucket update then touches only its rows.  Without it (the
+    legacy 2-arg closure), the bucketed path still pipelines both
+    collective phases but runs ONE full-shard update between them.
+
     Returns ``(new_params, aux)``.
     """
     axes = axis_name if isinstance(axis_name, tuple) else (axis_name,)
@@ -215,27 +408,85 @@ def scatter_update_gather(
     for a in axes:
         n *= lax.axis_size(a)
     if spec is None:
-        spec = flat_spec(params, n)
+        spec = flat_spec(params, n, bucket_elems=bucket_elems)
     assert spec.n_shards == n, (spec.n_shards, n)
-
-    g_flat = flat_pack(grads, spec)
-    w = g_flat if wire_dtype is None else g_flat.astype(wire_dtype)
-    g_shard = lax.psum_scatter(w, axes, scatter_dimension=0, tiled=True)
-    g_shard = g_shard.astype(spec.dtype) / n
-
-    p_flat = _pvary(flat_pack(params, spec), axes)
-    p_shard = lax.dynamic_slice_in_dim(
-        p_flat, _flat_axis_index(axes) * spec.shard_len, spec.shard_len
-    )
-    new_p_shard, aux = opt_update(p_shard, g_shard)
     # all_gather_invariant (vma-checked jax): the gathered params are
     # identical on every shard and must re-enter the step dp-INVARIANT
     # to match the params' out_spec; plain all_gather on older jax
     gather = getattr(lax, "all_gather_invariant", lax.all_gather)
-    p_new = gather(
-        new_p_shard.astype(spec.dtype), axes, axis=0, tiled=True
-    )
-    return flat_unpack(p_new, spec), aux
+
+    if spec.n_buckets == 1:
+        g_flat = flat_pack(grads, spec)
+        w = g_flat if wire_dtype is None else g_flat.astype(wire_dtype)
+        g_shard = lax.psum_scatter(w, axes, scatter_dimension=0, tiled=True)
+        g_shard = g_shard.astype(spec.dtype) / n
+
+        p_flat = _pvary(flat_pack(params, spec), axes)
+        p_shard = lax.dynamic_slice_in_dim(
+            p_flat, _flat_axis_index(axes) * spec.shard_len, spec.shard_len
+        )
+        if opt_state is None:
+            new_p_shard, aux = opt_update(p_shard, g_shard)
+        else:
+            new_p_shard, aux = opt_update(p_shard, g_shard, opt_state)
+        p_new = gather(
+            new_p_shard.astype(spec.dtype), axes, axis=0, tiled=True
+        )
+        return flat_unpack(p_new, spec), aux
+
+    # -- bucketed pipeline ------------------------------------------------
+    nb, bs = spec.n_buckets, spec.bucket_shard_len
+    me = _flat_axis_index(axes)
+
+    # phase 1: per-bucket reduce-scatter (each depends only on its own
+    # leaves' grads — the scheduler starts bucket 0's wire while the
+    # backward still computes later buckets' gradients)
+    g_shards = []
+    for i in range(nb):
+        gb = flat_pack_bucket(grads, spec, i)
+        w = gb if wire_dtype is None else gb.astype(wire_dtype)
+        gs = lax.psum_scatter(w, axes, scatter_dimension=0, tiled=True)
+        g_shards.append(gs.astype(spec.dtype) / n)
+
+    # phase 2: per-bucket param-shard slice + optimizer update.  The
+    # optimizer-shard flat layout becomes bucket-major (bucket i's 1/N
+    # rows at [i*bs:(i+1)*bs]) — internal only; unpack restores the
+    # original leaf order exactly.
+    p_buckets = [
+        lax.dynamic_slice_in_dim(
+            _pvary(flat_pack_bucket(params, spec, i), axes), me * bs, bs
+        )
+        for i in range(nb)
+    ]
+    if opt_state is None:
+        # legacy closure: one full-shard update between the pipelined
+        # collective phases
+        new_p, aux = opt_update(
+            jnp.concatenate(p_buckets), jnp.concatenate(g_shards)
+        )
+        new_p_buckets = [
+            lax.slice_in_dim(new_p, i * bs, (i + 1) * bs)
+            for i in range(nb)
+        ]
+    else:
+        new_p_buckets, aux_parts = [], []
+        for i in range(nb):
+            np_i, aux_i = opt_update(
+                p_buckets[i], g_shards[i],
+                _slice_shard_state(opt_state, spec, i),
+            )
+            new_p_buckets.append(np_i)
+            aux_parts.append(aux_i)
+        aux = _concat_shard_state(opt_state, aux_parts, spec)
+
+    # phase 3: per-bucket all-gather of the updated params — bucket
+    # i's gather dispatches as soon as ITS update lands, under bucket
+    # i+1's update compute
+    parts = [
+        gather(np_i.astype(spec.dtype), axes, axis=0, tiled=True)
+        for np_i in new_p_buckets
+    ]
+    return flat_unpack(jnp.concatenate(parts), spec), aux
 
 
 # ---------------------------------------------------------------------------

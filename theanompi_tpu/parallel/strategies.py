@@ -43,6 +43,11 @@ class ExchangeStrategy:
     initialize SHARD-shaped optimizer state.  Calling a zero1 strategy
     directly still allreduce-means (the two-phase wire it shares) —
     auxiliary exchanges like BN-stat sync route through it unchanged.
+
+    ``bucket_elems`` (call-time, from the ``exchange_bucket_mb``
+    config knob) buckets the exchange buffer so per-bucket collectives
+    overlap with compute — see ``exchange.allreduce_mean`` /
+    ``scatter_update_gather``; 0 keeps the monolithic exchange.
     """
 
     name: str
@@ -50,13 +55,22 @@ class ExchangeStrategy:
     two_phase: bool                  # reduce_scatter+all_gather vs psum
     zero1: bool = False              # sharded-optimizer step body
 
-    def __call__(self, tree, axis_name: str | tuple[str, ...]):
+    def __call__(self, tree, axis_name: str | tuple[str, ...],
+                 bucket_elems: int = 0):
         return allreduce_mean(
             tree,
             axis_name,
             wire_dtype=self.wire_dtype,
             two_phase=self.two_phase,
+            bucket_elems=bucket_elems,
         )
+
+    def bucket_elems(self, bucket_mb: float, dtype_bytes: int = 4) -> int:
+        """``exchange_bucket_mb`` → elements of the fp32 master-width
+        exchange buffer per bucket (0 stays 0 = monolithic)."""
+        if not bucket_mb:
+            return 0
+        return max(1, int(float(bucket_mb) * 2**20 / dtype_bytes))
 
 
 STRATEGIES: dict[str, ExchangeStrategy] = {
@@ -76,6 +90,26 @@ STRATEGIES: dict[str, ExchangeStrategy] = {
         ExchangeStrategy("zero1_16", jnp.bfloat16, True, zero1=True),
     )
 }
+
+
+# exchange_bucket_mb default: DDP-style ~4 MiB buckets (Li et al.
+# 2020's knee between per-collective launch overhead and overlap
+# granularity); 0 = monolithic.  ONE resolver so the worker's summary,
+# the models' step bodies, and the validation always agree.
+DEFAULT_BUCKET_MB = 4.0
+
+
+def resolve_bucket_mb(config: dict | None) -> float:
+    """The ``exchange_bucket_mb`` config knob, validated: None/0 →
+    0.0 (monolithic), unset → ``DEFAULT_BUCKET_MB``."""
+    mb = float((config or {}).get(
+        "exchange_bucket_mb", DEFAULT_BUCKET_MB) or 0)
+    if mb < 0:
+        raise ValueError(
+            f"exchange_bucket_mb must be >= 0 (0 = monolithic "
+            f"exchange), got {mb}"
+        )
+    return mb
 
 
 def get_strategy(name: str) -> ExchangeStrategy:

@@ -125,6 +125,81 @@ def bsp_efficiency(
     }
 
 
+def bucketed_overlap(
+    *,
+    wire_bytes: float,
+    n_chips: int,
+    step_time_1chip: float,
+    bucket_bytes: float = 4 * 2**20,
+    overlap_frac: float = 2.0 / 3.0,
+    launch_s: float = 10e-6,
+    chip: ChipSpec = V5E,
+    links: int | None = None,
+) -> dict:
+    """Predicted win of the bucketed exchange (``exchange_bucket_mb``)
+    over the monolithic serialized tail, from bucket count and
+    per-bucket wire time.
+
+    Model (the pipeline bound composed with ``bsp_efficiency``'s
+    overlap budget):
+
+    - the MONOLITHIC exchange is one collective issued after the
+      packed grads exist — i.e. after the whole backward — so its
+      wire time is fully exposed: ``t_exposed_mono = t_ar(B)``;
+    - the BUCKETED exchange splits B into ``ceil(B / bucket_bytes)``
+      buckets; each bucket's reduce-scatter depends only on its own
+      leaves, so the scheduler can hide wire under the
+      ``overlap_frac`` backward budget.  Two floors remain exposed:
+      the launch overhead (``n_buckets * launch_s`` — why shrinking
+      buckets eventually LOSES; the DDP-default ~4 MiB sits near the
+      knee) and the LAST bucket's wire time, which has no later
+      compute to hide under: ``t_exposed = max(t_wire_total -
+      overlap_budget, t_bucket)``;
+    - ``bucket_bytes <= 0`` degrades to the monolithic model (the
+      ``bucket_mb=0`` config path).
+
+    Returns the predicted ``exposed_comm_frac`` for both arms — the
+    quantity ``bench.py``'s bucketed A/B row and ``trace_comm`` then
+    measure.
+    """
+    if n_chips <= 1 or wire_bytes <= 0:
+        return {
+            "n_buckets": 1, "t_wire_ms": 0.0,
+            "t_exposed_monolithic_ms": 0.0,
+            "t_exposed_bucketed_ms": 0.0, "overlap_win_ms": 0.0,
+            "exposed_comm_frac_monolithic": 0.0,
+            "exposed_comm_frac_bucketed": 0.0,
+        }
+    n_buckets = (
+        1 if bucket_bytes <= 0 or bucket_bytes >= wire_bytes
+        else math.ceil(wire_bytes / bucket_bytes)
+    )
+    t_mono = allreduce_time(wire_bytes, n_chips, chip, links) + launch_s
+    if n_buckets == 1:
+        t_wire, t_bucket, t_exposed = t_mono, t_mono, t_mono
+    else:
+        t_bucket = (
+            allreduce_time(wire_bytes / n_buckets, n_chips, chip, links)
+            + launch_s
+        )
+        t_wire = n_buckets * t_bucket
+        budget = overlap_frac * step_time_1chip
+        t_exposed = max(t_wire - budget, t_bucket)
+
+    def frac(exposed: float) -> float:
+        return exposed / (step_time_1chip + exposed)
+
+    return {
+        "n_buckets": n_buckets,
+        "t_wire_ms": t_wire * 1e3,
+        "t_exposed_monolithic_ms": t_mono * 1e3,
+        "t_exposed_bucketed_ms": t_exposed * 1e3,
+        "overlap_win_ms": (t_mono - t_exposed) * 1e3,
+        "exposed_comm_frac_monolithic": frac(t_mono),
+        "exposed_comm_frac_bucketed": frac(t_exposed),
+    }
+
+
 def predict_table(
     *,
     step_time_1chip: float,

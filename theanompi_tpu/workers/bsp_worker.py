@@ -83,11 +83,16 @@ def run(
     # build so a typo'd name fails in milliseconds, and so the run
     # summary can carry the resolved name (zero1 runs shard their
     # optimizer state — the checkpoint format follows)
-    from theanompi_tpu.parallel import get_strategy
+    from theanompi_tpu.parallel import get_strategy, resolve_bucket_mb
 
     strat = get_strategy(
         exch_strategy or cfg.get("exch_strategy", "ici32")
     )
+    # bucketed-exchange knob, validated here for the same reason as
+    # the strategy name: a bad value must fail before the model build
+    # (resolve_bucket_mb is the ONE resolver — the models' step
+    # bodies read the same rule, so summary and compile agree)
+    bucket_mb = resolve_bucket_mb(cfg)
     mesh = _build_mesh(devices, cfg)
     n_replicas = dp_replicas(mesh)
     if n_epochs is not None:
@@ -111,7 +116,9 @@ def run(
             f"BSP: {n_replicas} replicas, {data.n_batch_train} train batches"
             f" x {data.global_batch} global batch, "
             f"exchange={strat.name}"
-            + (" (ZeRO-1 sharded optimizer)" if strat.zero1 else ""),
+            + (" (ZeRO-1 sharded optimizer)" if strat.zero1 else "")
+            + (f", buckets {bucket_mb:g} MiB" if bucket_mb else
+               ", monolithic exchange"),
             flush=True,
         )
 
@@ -165,6 +172,7 @@ def run(
     return {
         "epochs": model.epoch,
         "exch_strategy": strat.name,
+        "exchange_bucket_mb": bucket_mb,
         "iterations": recorder.n_iter,
         "final_train_loss": (
             recorder.train_losses[-1] if recorder.train_losses else None
