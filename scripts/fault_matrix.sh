@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# Fault x recovery matrix — the deterministic self-healing grid
+# (docs/RESILIENCE.md): die / hang / sigterm / corrupt_ckpt faults
+# against npz / .shards checkpoints, driven through one supervised
+# launch() each, plus the fast resilience units.
+#
+# Runs ALONGSIDE scripts/tier1.sh, not inside it: the end-to-end
+# cells are marked `slow` (each is a multi-process training drill) so
+# tier-1 stays fast; this script opts into them via TM_SLOW_TESTS.
+#
+# Usage: bash scripts/fault_matrix.sh [extra pytest args]
+
+cd "$(dirname "$0")/.." || exit 2
+
+python -m compileall -q theanompi_tpu/ || {
+    echo "fault_matrix: python -m compileall failed (syntax error above)" >&2
+    exit 2
+}
+
+set -o pipefail
+rm -f /tmp/_fm.log
+
+# fast units first (supervisor loop, fault parsing, checkpoint
+# validation/quarantine/retention) — fail in seconds if the layer is
+# broken before paying for the training drills
+timeout -k 10 870 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_supervisor.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly "$@" \
+    2>&1 | tee /tmp/_fm.log || exit $?
+
+# the grid: every fault_matrix-tagged end-to-end drill (supervised
+# die+hang+corrupt in one launch, sigterm zero-step preemption,
+# sharded-format corruption fallback, budget exhaustion)
+timeout -k 10 1800 env JAX_PLATFORMS=cpu TM_SLOW_TESTS=1 \
+    python -m pytest tests/ -q -m fault_matrix \
+    -p no:cacheprovider -p no:xdist -p no:randomly "$@" \
+    2>&1 | tee -a /tmp/_fm.log
+exit $?

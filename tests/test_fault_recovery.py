@@ -1,14 +1,24 @@
 """Preemption / fault recovery (SURVEY §5.3, VERDICT r1 item 9).
 
 The reference's failure story: checkpoint every epoch, restart from
-the last one.  Prove the rebuild honors it end-to-end: a worker
-process is killed MID-EPOCH via the deterministic fault knob
-(``TM_FAULT_AT`` → ``os._exit(137)``, no cleanup — a preemption), a
-rerun with ``resume=True`` picks up from the last committed
-checkpoint, finishes the remaining epochs, and the loss keeps
-dropping across the death.
+the last one.  Prove the rebuild honors it end-to-end — and (PR 3)
+that the SUPERVISOR closes the loop without an operator:
+
+- manual kill-and-rerun (the original drill, kept verbatim),
+- one supervised ``launch()`` surviving an injected ``die``, ``hang``
+  and ``corrupt_ckpt`` in a single invocation — zero operator action,
+  loss decreasing across every recovery, the report naming each
+  restart's cause and resumed-from step,
+- graceful SIGTERM preemption losing ZERO steps (mid-epoch
+  checkpoint + mid-epoch resume),
+- post-commit corruption quarantined and fallen back from, in BOTH
+  checkpoint formats (npz and ``.shards``).
+
+The deterministic grid cells are tagged ``fault_matrix``
+(``scripts/fault_matrix.sh`` runs them as a suite).
 """
 
+import json
 import os
 import subprocess
 import sys
@@ -103,8 +113,179 @@ class TestKillAndResume:
     def test_bad_fault_spec_rejected(self, monkeypatch):
         from theanompi_tpu.utils import faults
 
-        monkeypatch.setattr(faults, "_parsed", "unset")
+        faults.reset_fault_cache()
         monkeypatch.setenv("TM_FAULT_AT", "nonsense")
         with pytest.raises(ValueError, match="TM_FAULT_AT"):
             faults.maybe_inject_fault(0, 0)
-        monkeypatch.setattr(faults, "_parsed", "unset")
+        faults.reset_fault_cache()
+
+
+# ---------------------------------------------------------------------------
+# PR 3: supervised self-healing — no operator in the loop
+# ---------------------------------------------------------------------------
+
+def _wresnet_kwargs(ckpt, n_epochs, **cfg):
+    return dict(
+        config={"batch_size": 4, "n_epochs": n_epochs, "depth": 10,
+                "widen": 1, "lr": 0.05, "lr_schedule": None,
+                "n_train": 128, "n_val": 32, **cfg},
+        checkpoint_dir=str(ckpt),
+        verbose=True,
+    )
+
+
+def _supervised_launch(ckpt, fault_at, n_epochs, *, stall_timeout_s=25.0,
+                       max_restarts=5, **cfg):
+    """One supervised launch() with faults injected in the child env —
+    the supervisor and assertions run in THIS process; children are
+    separate CPU-jax processes."""
+    from theanompi_tpu import launcher
+
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        TM_TPU_PLATFORM="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        XLA_FLAGS="--xla_force_host_platform_device_count=4",
+        PYTHONPATH=str(REPO),
+        TM_FAULT_AT=fault_at,
+    )
+    return launcher.launch(
+        "theanompi_tpu.workers.bsp_worker",
+        devices=list(range(4)),
+        modelfile="theanompi_tpu.models.wresnet",
+        modelclass="WResNet",
+        mode="supervised",
+        rule_kwargs=_wresnet_kwargs(ckpt, n_epochs, **cfg),
+        supervise=dict(
+            max_restarts=max_restarts,
+            stall_timeout_s=stall_timeout_s,
+            startup_grace_s=600.0,
+            backoff_base_s=0.2,
+            backoff_cap_s=1.0,
+            poll_interval_s=0.25,
+            seed=0,
+            env=env,
+        ),
+    )
+
+
+def _final_recorder_state(ckpt: Path) -> dict:
+    """The newest checkpoint sidecar's recorder history — the full
+    loss curve across every restart."""
+    sides = sorted(
+        ckpt.glob("ckpt_*.json"),
+        key=lambda p: int(p.stem.split("_")[1]),
+    )
+    return json.loads(sides[-1].read_text())["recorder"]
+
+
+@pytest.mark.slow
+@pytest.mark.fault_matrix
+class TestSupervisedSelfHealing:
+    def test_die_hang_corrupt_single_launch(self, tmp_path):
+        """The acceptance drill: one launch() survives a mid-epoch
+        die, a hang, and a post-commit checkpoint corruption —
+        finishing all epochs with zero operator intervention."""
+        ckpt = tmp_path / "ck"
+        h = _supervised_launch(
+            ckpt, "1:3:die,2:2:hang,3:1:corrupt_ckpt", n_epochs=5
+        )
+        report = h.wait()
+
+        assert report["completed"]
+        assert report["n_restarts"] == 3
+        causes = [e["cause"] for e in report["restarts"]]
+        assert causes == ["preemption", "hang", "preemption"]
+        # every restart names where it resumed from
+        assert all(
+            e["resumed_from"] is not None for e in report["restarts"]
+        )
+        # recovery was measured and aggregated
+        assert report["mttr_s"] is not None and report["mttr_s"] > 0
+        assert report["final_heartbeat"]["status"] == "completed"
+
+        # the corrupted checkpoint was quarantined, never deleted, and
+        # never loaded (the resume fell back to the previous one)
+        assert any("corrupt" in p.name for p in ckpt.iterdir())
+
+        # loss decreasing across EVERY recovery: per-epoch means of
+        # the stitched curve are strictly monotone (the run is
+        # deterministic — resumes replay the same batch schedule)
+        rec = _final_recorder_state(ckpt)
+        losses = np.asarray(rec["train_losses"])
+        assert len(losses) == 5 * 8, len(losses)
+        epoch_means = losses.reshape(5, 8).mean(axis=1)
+        assert np.all(np.diff(epoch_means) < 0), epoch_means
+        # restart history rides along in the checkpointed recorder —
+        # minus the 'hang' event, which was recorded into exactly the
+        # checkpoint the corrupt fault destroyed (rolled-back state
+        # rolls back its bookkeeping too; the supervisor report above
+        # is the authoritative full history)
+        assert [e["cause"] for e in rec["restart_events"]] == [
+            "preemption", "preemption",
+        ]
+
+    def test_sigterm_preemption_loses_zero_steps(self, tmp_path):
+        """Graceful preemption: SIGTERM → checkpoint at the next
+        iteration boundary → clean exit → supervised relaunch resumes
+        MID-EPOCH.  The loss curve has exactly n_epochs * n_batches
+        entries: no step was lost or repeated."""
+        ckpt = tmp_path / "ck"
+        h = _supervised_launch(ckpt, "1:2:sigterm", n_epochs=3)
+        report = h.wait()
+
+        assert report["completed"]
+        assert report["n_restarts"] == 1
+        (ev,) = report["restarts"]
+        assert ev["cause"] == "sigterm"
+        assert ev["exit_code"] == 0  # it drained CLEANLY
+        assert ev["resumed_from"] == [1, 3]  # mid-epoch, exact iter
+
+        rec = _final_recorder_state(ckpt)
+        assert len(rec["train_losses"]) == 3 * 8  # zero lost steps
+        assert rec["restart_events"][0]["resumed_iter"] == 3
+        # training kept dropping across the drain/resume
+        losses = np.asarray(rec["train_losses"])
+        assert losses[-8:].mean() < losses[:8].mean()
+
+    def test_corrupt_fallback_sharded_format(self, tmp_path):
+        """corrupt_ckpt → quarantine + fallback for the ``.shards``
+        format (the npz format is covered by the acceptance drill)."""
+        ckpt = tmp_path / "ck"
+        h = _supervised_launch(
+            ckpt, "2:1:corrupt_ckpt", n_epochs=4,
+            checkpoint_format="sharded",
+        )
+        report = h.wait()
+
+        assert report["completed"]
+        assert report["n_restarts"] == 1
+        assert report["restarts"][0]["cause"] == "preemption"
+        # the corrupted .shards dir was quarantined...
+        assert any(
+            p.name.endswith(".corrupt") and p.is_dir()
+            for p in ckpt.iterdir()
+        )
+        # ...and healthy sharded checkpoints exist through the end
+        from theanompi_tpu.utils import (
+            is_sharded_checkpoint,
+            latest_checkpoint,
+        )
+
+        final = latest_checkpoint(ckpt, validate=True)
+        assert final is not None and is_sharded_checkpoint(final)
+        assert int(final.name.split("_")[1].split(".")[0]) == 3
+
+    def test_budget_exhaustion_fails_loudly(self, tmp_path):
+        """Four faults, budget of two restarts: the supervisor gives
+        up with SupervisorGaveUp, not a silent infinite loop."""
+        from theanompi_tpu.utils.supervisor import SupervisorGaveUp
+
+        ckpt = tmp_path / "ck"
+        h = _supervised_launch(
+            ckpt, "0:1:die,0:2:die,0:3:die,0:4:die",
+            n_epochs=2, max_restarts=2,
+        )
+        with pytest.raises(SupervisorGaveUp, match="budget exhausted"):
+            h.wait()

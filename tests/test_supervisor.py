@@ -1,0 +1,550 @@
+"""Resilience layer units (utils/supervisor.py, utils/faults.py,
+checkpoint validation) — the fast tier of the PR-3 self-healing
+story.  End-to-end supervised drills live in test_fault_recovery.py
+(slow tier / fault matrix).
+"""
+
+import json
+import os
+import signal
+import sys
+import time
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from theanompi_tpu.utils import faults
+from theanompi_tpu.utils import supervisor as sup
+from theanompi_tpu.utils.checkpoint import (
+    latest_checkpoint,
+    load_checkpoint,
+    prune_checkpoints,
+    quarantine_checkpoint,
+    save_checkpoint,
+    verify_checkpoint,
+)
+from theanompi_tpu.utils.recorder import Recorder
+
+
+# ---------------------------------------------------------------------------
+# heartbeats
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def hb_file(tmp_path, monkeypatch):
+    p = tmp_path / "hb.json"
+    monkeypatch.setenv(sup.HEARTBEAT_ENV, str(p))
+    sup.reset_heartbeat_cache()
+    yield p
+    sup.reset_heartbeat_cache()
+
+
+class TestHeartbeat:
+    def test_noop_without_env(self, tmp_path, monkeypatch):
+        monkeypatch.delenv(sup.HEARTBEAT_ENV, raising=False)
+        sup.reset_heartbeat_cache()
+        sup.heartbeat(5, 0, 1)  # must not raise, must not write
+        assert list(tmp_path.iterdir()) == []
+        sup.reset_heartbeat_cache()
+
+    def test_stamp_and_read(self, hb_file):
+        sup.heartbeat(7, epoch=1, it=3, resumed_from=[1, 2])
+        hb = sup.read_heartbeat(hb_file)
+        assert hb["progress"] == 7
+        assert hb["epoch"] == 1 and hb["iter"] == 3
+        assert hb["status"] == "running"
+        assert hb["resumed_from"] == [1, 2]
+
+    def test_running_stamps_throttled_status_not(self, hb_file):
+        sup.heartbeat(1, 0, 0)
+        t1 = sup.read_heartbeat(hb_file)["time"]
+        sup.heartbeat(2, 0, 1)  # within 50 ms → skipped
+        assert sup.read_heartbeat(hb_file)["progress"] == 1
+        sup.heartbeat(2, 0, 1, status="preempted")  # status: always
+        hb = sup.read_heartbeat(hb_file)
+        assert hb["status"] == "preempted" and hb["time"] >= t1
+
+    def test_flush_final_preserves_progress(self, hb_file):
+        sup.heartbeat(42, epoch=3, it=5)
+        sup.flush_final_heartbeat(ok=True)
+        hb = sup.read_heartbeat(hb_file)
+        assert hb["status"] == "completed"
+        assert hb["progress"] == 42  # the shutdown stamp keeps count
+
+    def test_flush_final_never_upgrades_terminal_status(self, hb_file):
+        # graceful drain then clean shutdown: finish_distributed's
+        # ok=True stamp must NOT turn 'preempted' into 'completed' —
+        # the supervisor would classify clean and abandon the epochs
+        sup.heartbeat(9, 1, 2, status="preempted")
+        sup.flush_final_heartbeat(ok=True)
+        assert sup.read_heartbeat(hb_file)["status"] == "preempted"
+
+    def test_read_tolerates_garbage(self, tmp_path):
+        p = tmp_path / "junk.json"
+        p.write_text("{not json")
+        assert sup.read_heartbeat(p) is None
+        assert sup.read_heartbeat(tmp_path / "missing.json") is None
+
+
+# ---------------------------------------------------------------------------
+# graceful preemption flag
+# ---------------------------------------------------------------------------
+
+class TestPreemption:
+    def test_sigterm_sets_flag(self):
+        try:
+            assert sup.install_preemption_handler()
+            assert not sup.preemption_requested()
+            signal.raise_signal(signal.SIGTERM)
+            assert sup.preemption_requested()
+            sup.reset_preemption()
+            assert not sup.preemption_requested()
+        finally:
+            sup.uninstall_preemption_handler()
+
+    def test_uninstall_restores_previous_handler(self):
+        prev = signal.getsignal(signal.SIGTERM)
+        sup.install_preemption_handler()
+        sup.install_preemption_handler()  # re-install keeps ORIGINAL
+        assert signal.getsignal(signal.SIGTERM) is sup._on_sigterm
+        sup.uninstall_preemption_handler()
+        # an in-process host gets its SIGTERM semantics back
+        assert signal.getsignal(signal.SIGTERM) == prev
+        sup.uninstall_preemption_handler()  # idempotent
+
+
+# ---------------------------------------------------------------------------
+# fault parsing / actions
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def clean_faults(monkeypatch):
+    monkeypatch.delenv("TM_FAULT_AT", raising=False)
+    monkeypatch.delenv("TM_FAULT_STATE", raising=False)
+    faults.reset_fault_cache()
+    yield monkeypatch
+    faults.reset_fault_cache()
+
+
+class TestFaultParsing:
+    def test_multi_fault_list_with_actions(self, clean_faults):
+        clean_faults.setenv(
+            "TM_FAULT_AT", "1:3:die, 2:1:hang ,3:2:corrupt_ckpt,4:0"
+        )
+        assert faults._target() == [
+            (1, 3, "die"), (2, 1, "hang"),
+            (3, 2, "corrupt_ckpt"), (4, 0, "die"),
+        ]
+
+    def test_bad_action_rejected(self, clean_faults):
+        clean_faults.setenv("TM_FAULT_AT", "1:2:explode")
+        with pytest.raises(ValueError, match="TM_FAULT_AT"):
+            faults.maybe_inject_fault(1, 2)
+
+    def test_reset_fault_cache_rereads_env(self, clean_faults):
+        clean_faults.setenv("TM_FAULT_AT", "1:1")
+        assert faults._target() == [(1, 1, "die")]
+        clean_faults.setenv("TM_FAULT_AT", "2:2:hang")
+        # cached until reset — the one-comparison hot path
+        assert faults._target() == [(1, 1, "die")]
+        faults.reset_fault_cache()
+        assert faults._target() == [(2, 2, "hang")]
+
+    def test_sigterm_action_fires_once(self, clean_faults):
+        clean_faults.setenv("TM_FAULT_AT", "0:5:sigterm")
+        try:
+            sup.install_preemption_handler()
+            faults.maybe_inject_fault(0, 3, 7)  # chunk covers iter 5
+            assert sup.preemption_requested()
+            sup.reset_preemption()
+            faults.maybe_inject_fault(0, 5)  # already fired: no-op
+            assert not sup.preemption_requested()
+        finally:
+            sup.uninstall_preemption_handler()
+
+    def test_state_file_survives_restart(self, clean_faults, tmp_path):
+        state = tmp_path / "fault_state"
+        clean_faults.setenv("TM_FAULT_AT", "0:0:sigterm,1:0:sigterm")
+        clean_faults.setenv("TM_FAULT_STATE", str(state))
+        try:
+            sup.install_preemption_handler()
+            faults.maybe_inject_fault(0, 0)
+            assert sup.preemption_requested()
+            assert state.read_text().strip() == "0"
+            # simulate the relaunched process: fresh parse, same env
+            faults.reset_fault_cache()
+            sup.reset_preemption()
+            faults.maybe_inject_fault(0, 0)  # fired last life: skipped
+            assert not sup.preemption_requested()
+            faults.maybe_inject_fault(1, 0)  # the next fault still fires
+            assert sup.preemption_requested()
+        finally:
+            sup.uninstall_preemption_handler()
+
+    def test_corrupt_without_dir_raises(self, clean_faults):
+        clean_faults.setenv("TM_FAULT_AT", "0:0:corrupt_ckpt")
+        with pytest.raises(RuntimeError, match="checkpoint_dir"):
+            faults.maybe_inject_fault(0, 0)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint digests / validation / quarantine / retention
+# ---------------------------------------------------------------------------
+
+def _trees():
+    return {
+        "params": {"w": jnp.arange(60.0).reshape(6, 10),
+                   "b": jnp.ones(10)},
+        "opt_state": {"m": {"w": jnp.zeros((6, 10)),
+                            "b": jnp.zeros(10)}},
+    }
+
+
+def _flip_bytes(path: Path, n: int = 16) -> None:
+    size = path.stat().st_size
+    with open(path, "r+b") as f:
+        off = max(0, size // 2 - n // 2)
+        f.seek(off)
+        chunk = f.read(n)
+        f.seek(off)
+        f.write(bytes(b ^ 0xFF for b in chunk))
+
+
+class TestCheckpointValidation:
+    def test_verify_ok_and_meta_clean(self, tmp_path):
+        trees = _trees()
+        p = save_checkpoint(tmp_path, 3, trees, meta={"epoch": 3})
+        assert verify_checkpoint(p)
+        _, meta = load_checkpoint(p, trees)
+        assert meta == {"epoch": 3}  # digest bookkeeping is internal
+
+    def test_bit_flip_detected(self, tmp_path):
+        p = save_checkpoint(tmp_path, 0, _trees())
+        _flip_bytes(p)
+        assert not verify_checkpoint(p)
+
+    def test_truncation_detected(self, tmp_path):
+        p = save_checkpoint(tmp_path, 0, _trees())
+        with open(p, "r+b") as f:
+            f.truncate(p.stat().st_size // 2)
+        assert not verify_checkpoint(p)
+
+    def test_legacy_sidecar_verifies_structurally(self, tmp_path):
+        p = save_checkpoint(tmp_path, 0, _trees())
+        # strip digests, as a pre-PR3 checkpoint would look
+        side = p.with_suffix(".json")
+        meta = json.loads(side.read_text())
+        meta.pop("_digests")
+        side.write_text(json.dumps(meta))
+        assert verify_checkpoint(p)
+        _flip_bytes(p)  # npz zip CRC still catches gross corruption
+        assert not verify_checkpoint(p)
+
+    def test_validate_falls_back_and_quarantines(self, tmp_path):
+        trees = _trees()
+        for s in range(3):
+            save_checkpoint(tmp_path, s, trees, meta={"epoch": s})
+        newest = tmp_path / "ckpt_2.npz"
+        _flip_bytes(newest)
+        p = latest_checkpoint(tmp_path, validate=True)
+        assert p is not None and p.name == "ckpt_1.npz"
+        # corrupt one renamed, never deleted — post-mortem evidence
+        assert (tmp_path / "ckpt_2.npz.corrupt").exists()
+        assert not newest.exists()
+        # and it stays invisible to discovery from now on
+        assert latest_checkpoint(tmp_path).name == "ckpt_1.npz"
+
+    def test_all_corrupt_returns_none(self, tmp_path):
+        save_checkpoint(tmp_path, 0, _trees())
+        _flip_bytes(tmp_path / "ckpt_0.npz")
+        assert latest_checkpoint(tmp_path, validate=True) is None
+
+    def test_quarantine_name_collision(self, tmp_path):
+        save_checkpoint(tmp_path, 0, _trees())
+        q1 = quarantine_checkpoint(tmp_path / "ckpt_0.npz")
+        save_checkpoint(tmp_path, 0, _trees())
+        q2 = quarantine_checkpoint(tmp_path / "ckpt_0.npz")
+        assert q1.exists() and q2.exists() and q1 != q2
+
+
+class TestRetention:
+    def test_keep_last_prunes_oldest(self, tmp_path):
+        trees = _trees()
+        for s in range(5):
+            save_checkpoint(tmp_path, s, trees, keep_last=2)
+        kept = sorted(p.name for p in tmp_path.glob("ckpt_*.npz"))
+        assert kept == ["ckpt_3.npz", "ckpt_4.npz"]
+        # sidecars pruned along with their npz
+        assert sorted(p.name for p in tmp_path.glob("ckpt_*.json")) == [
+            "ckpt_3.json", "ckpt_4.json",
+        ]
+
+    def test_never_collects_quarantined(self, tmp_path):
+        trees = _trees()
+        save_checkpoint(tmp_path, 0, trees)
+        quarantine_checkpoint(tmp_path / "ckpt_0.npz")
+        for s in range(1, 4):
+            save_checkpoint(tmp_path, s, trees, keep_last=1)
+        assert (tmp_path / "ckpt_0.npz.corrupt").exists()
+        assert [p.name for p in tmp_path.glob("ckpt_*.npz")] == [
+            "ckpt_3.npz"
+        ]
+
+    def test_keep_last_must_be_positive(self, tmp_path):
+        with pytest.raises(ValueError, match="keep_last"):
+            prune_checkpoints(tmp_path, 0)
+
+
+class TestShardedValidation:
+    def test_corrupt_shard_detected_and_fallback(self, mesh8, tmp_path):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from theanompi_tpu.utils.sharded_checkpoint import (
+            save_sharded_checkpoint,
+            verify_sharded_checkpoint,
+        )
+
+        sh = NamedSharding(mesh8, P("data"))
+        trees = {
+            "params": {
+                "w": jax.device_put(
+                    jnp.arange(64.0).reshape(8, 8), sh
+                )
+            }
+        }
+        for s in range(2):
+            save_sharded_checkpoint(tmp_path, s, trees, {"epoch": s})
+        newest = tmp_path / "ckpt_1.shards"
+        assert verify_sharded_checkpoint(newest)
+        shard = max(
+            (p for p in newest.iterdir() if p.suffix == ".npy"),
+            key=lambda p: p.stat().st_size,
+        )
+        _flip_bytes(shard, n=8)
+        assert not verify_sharded_checkpoint(newest)
+        p = latest_checkpoint(tmp_path, validate=True)
+        assert p is not None and p.name == "ckpt_0.shards"
+        assert (tmp_path / "ckpt_1.shards.corrupt").is_dir()
+
+    def test_sharded_keep_last(self, mesh8, tmp_path):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from theanompi_tpu.utils.sharded_checkpoint import (
+            save_sharded_checkpoint,
+        )
+
+        sh = NamedSharding(mesh8, P("data"))
+        trees = {
+            "params": {
+                "w": jax.device_put(jnp.ones((8, 4)), sh)
+            }
+        }
+        for s in range(4):
+            save_sharded_checkpoint(tmp_path, s, trees, keep_last=2)
+        kept = sorted(p.name for p in tmp_path.glob("ckpt_*.shards"))
+        assert kept == ["ckpt_2.shards", "ckpt_3.shards"]
+
+
+# ---------------------------------------------------------------------------
+# recorder restart bookkeeping
+# ---------------------------------------------------------------------------
+
+class TestRecorderRestarts:
+    def test_record_and_roundtrip(self):
+        rec = Recorder(verbose=False)
+        rec.record_restart("preemption", resumed_epoch=2,
+                           recovery_s=4.0)
+        rec.record_restart("hang", resumed_epoch=3, resumed_iter=5,
+                           recovery_s=6.0)
+        assert rec.mttr_s == pytest.approx(5.0)
+        rec2 = Recorder(verbose=False)
+        rec2.load_state_dict(rec.state_dict())
+        assert rec2.restart_events == rec.restart_events
+        assert rec2.mttr_s == pytest.approx(5.0)
+
+    def test_old_state_dict_loads(self):
+        rec = Recorder(verbose=False)
+        d = rec.state_dict()
+        d.pop("restart_events")  # pre-PR3 checkpoint
+        rec2 = Recorder(verbose=False)
+        rec2.load_state_dict(d)
+        assert rec2.restart_events == [] and rec2.mttr_s is None
+
+    def test_restart_context_env(self, monkeypatch):
+        monkeypatch.setenv(
+            sup.RESTART_CTX_ENV,
+            json.dumps({"restart": 2, "cause": "hang",
+                        "t_fail": time.time() - 1.0}),
+        )
+        rec = Recorder(verbose=False)
+        sup.record_restart_into(rec, 4, None)
+        (ev,) = rec.restart_events
+        assert ev["cause"] == "hang" and ev["restart"] == 2
+        assert ev["resumed_epoch"] == 4
+        assert 0.5 < ev["recovery_s"] < 30.0
+
+
+# ---------------------------------------------------------------------------
+# supervisor: classification, backoff, fast subprocess drills (no jax
+# in the children — they are plain python, so this stays in the fast
+# tier)
+# ---------------------------------------------------------------------------
+
+class TestClassifyExit:
+    @pytest.mark.parametrize("rc,hb,want", [
+        (0, "completed", "clean"),
+        (0, None, "clean"),
+        (0, "preempted", "sigterm"),
+        (137, None, "preemption"),
+        (-signal.SIGKILL, None, "preemption"),
+        (143, None, "sigterm"),
+        (-signal.SIGTERM, None, "sigterm"),
+        (1, None, "crash"),
+        (3, "running", "crash"),
+    ])
+    def test_table(self, rc, hb, want):
+        assert sup.classify_exit(rc, hb) == want
+
+
+class TestBackoff:
+    def test_exponential_with_cap_and_jitter(self, tmp_path):
+        s = sup.Supervisor(
+            cmd_for=lambda r: ["true"], checkpoint_dir=str(tmp_path),
+            backoff_base_s=1.0, backoff_cap_s=8.0,
+            backoff_jitter=0.0, seed=0,
+        )
+        assert [s._backoff(a) for a in (1, 2, 3, 4, 5)] == [
+            1.0, 2.0, 4.0, 8.0, 8.0,
+        ]
+        j = sup.Supervisor(
+            cmd_for=lambda r: ["true"], checkpoint_dir=str(tmp_path),
+            backoff_base_s=1.0, backoff_cap_s=8.0,
+            backoff_jitter=0.5, seed=7,
+        )
+        d = j._backoff(1)
+        assert 1.0 <= d <= 1.5
+
+
+def _write_child(tmp_path: Path, body: str) -> Path:
+    p = tmp_path / "child.py"
+    p.write_text(body)
+    return p
+
+
+class TestSupervisorLoop:
+    def test_clean_completion_no_restarts(self, tmp_path):
+        child = _write_child(tmp_path, """
+import json, os, time
+p = os.environ["TM_HEARTBEAT_FILE"]
+open(p, "w").write(json.dumps(
+    {"progress": 3, "status": "completed", "time": time.time()}))
+""")
+        s = sup.Supervisor(
+            cmd_for=lambda r: [sys.executable, str(child)],
+            checkpoint_dir=str(tmp_path / "ck"),
+            poll_interval_s=0.05, verbose=False, seed=0,
+        )
+        report = s.run()
+        assert report["completed"] and report["n_restarts"] == 0
+        assert report["final_heartbeat"]["status"] == "completed"
+
+    def test_die_then_complete_with_resume(self, tmp_path):
+        # dies 137 on the first life (no marker file), completes on
+        # the second — and must be relaunched with resume=True
+        child = _write_child(tmp_path, """
+import json, os, sys, time
+marker = os.path.join(os.path.dirname(__file__), "lived")
+hb = os.environ["TM_HEARTBEAT_FILE"]
+resume = sys.argv[1] if len(sys.argv) > 1 else "fresh"
+open(hb, "w").write(json.dumps(
+    {"progress": 1, "status": "running", "time": time.time()}))
+time.sleep(0.3)
+if not os.path.exists(marker):
+    open(marker, "w").write("x")
+    os._exit(137)
+assert resume == "resume", resume
+ctx = json.loads(os.environ["TM_RESTART_CONTEXT"])
+assert ctx["cause"] == "preemption" and ctx["restart"] == 1
+open(hb, "w").write(json.dumps(
+    {"progress": 2, "status": "completed", "time": time.time(),
+     "resumed_from": [0, None]}))
+""")
+        s = sup.Supervisor(
+            cmd_for=lambda r: [
+                sys.executable, str(child), "resume" if r else "fresh"
+            ],
+            checkpoint_dir=str(tmp_path / "ck"),
+            backoff_base_s=0.01, backoff_cap_s=0.05,
+            poll_interval_s=0.05, verbose=False, seed=0,
+        )
+        report = s.run()
+        assert report["completed"] and report["n_restarts"] == 1
+        (ev,) = report["restarts"]
+        assert ev["cause"] == "preemption" and ev["exit_code"] == 137
+        assert ev["resumed_from"] == [0, None]
+        assert ev["recovery_s"] is not None
+
+    def test_hang_watchdog_kills_within_timeout(self, tmp_path):
+        child = _write_child(tmp_path, """
+import json, os, time
+hb = os.environ["TM_HEARTBEAT_FILE"]
+marker = os.path.join(os.path.dirname(__file__), "lived")
+open(hb, "w").write(json.dumps(
+    {"progress": 1, "status": "running", "time": time.time()}))
+if not os.path.exists(marker):
+    open(marker, "w").write("x")
+    time.sleep(600)   # the hang
+open(hb, "w").write(json.dumps(
+    {"progress": 2, "status": "completed", "time": time.time()}))
+""")
+        s = sup.Supervisor(
+            cmd_for=lambda r: [sys.executable, str(child)],
+            checkpoint_dir=str(tmp_path / "ck"),
+            stall_timeout_s=1.0, startup_grace_s=20.0,
+            backoff_base_s=0.01, backoff_cap_s=0.05,
+            poll_interval_s=0.05, verbose=False, seed=0,
+        )
+        t0 = time.monotonic()
+        report = s.run()
+        elapsed = time.monotonic() - t0
+        assert report["completed"] and report["n_restarts"] == 1
+        assert report["restarts"][0]["cause"] == "hang"
+        assert report["restarts"][0]["exit_code"] is None
+        assert elapsed < 20.0, f"watchdog too slow: {elapsed:.1f}s"
+
+    def test_budget_exhaustion_is_loud(self, tmp_path):
+        child = _write_child(tmp_path, """
+import json, os, time
+hb = os.environ["TM_HEARTBEAT_FILE"]
+open(hb, "w").write(json.dumps(
+    {"progress": int(time.time() * 1000) % 100000,
+     "status": "running", "time": time.time()}))
+time.sleep(0.2)
+os._exit(137)
+""")
+        s = sup.Supervisor(
+            cmd_for=lambda r: [sys.executable, str(child)],
+            checkpoint_dir=str(tmp_path / "ck"),
+            max_restarts=2, crash_loop_budget=99,
+            backoff_base_s=0.01, backoff_cap_s=0.05,
+            poll_interval_s=0.05, verbose=False, seed=0,
+        )
+        with pytest.raises(sup.SupervisorGaveUp,
+                           match="budget exhausted"):
+            s.run()
+
+    def test_crash_loop_gives_up_early(self, tmp_path):
+        s = sup.Supervisor(
+            cmd_for=lambda r: [sys.executable, "-c", "raise SystemExit(3)"],
+            checkpoint_dir=str(tmp_path / "ck"),
+            max_restarts=50, crash_loop_budget=2,
+            backoff_base_s=0.01, backoff_cap_s=0.05,
+            poll_interval_s=0.05, verbose=False, seed=0,
+        )
+        with pytest.raises(sup.SupervisorGaveUp, match="crash loop") as ei:
+            s.run()
+        # gave up after the crash-loop budget, far under max_restarts
+        assert ei.value.report["n_restarts"] <= 3

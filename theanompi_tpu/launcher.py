@@ -33,8 +33,16 @@ class LaunchHandle:
     mode: str
     proc: Optional[subprocess.Popen] = None
     result: Any = None
+    supervisor: Any = None  # utils.supervisor.Supervisor (supervised)
 
     def wait(self) -> Any:
+        if self.mode == "supervised" and self.supervisor is not None:
+            # blocks through failures: relaunches with resume=True
+            # until clean completion or the restart budget is spent
+            # (then utils.supervisor.SupervisorGaveUp propagates);
+            # returns the supervision report (restart causes, MTTR)
+            self.result = self.supervisor.run()
+            return self.result
         if self.mode == "subprocess" and self.proc is not None:
             rc = self.proc.wait()
             if rc != 0:
@@ -43,6 +51,9 @@ class LaunchHandle:
         return self.result
 
     def poll(self) -> Optional[int]:
+        if self.mode == "supervised" and self.supervisor is not None:
+            p = self.supervisor.proc
+            return p.poll() if p is not None else None
         if self.proc is not None:
             return self.proc.poll()
         return 0
@@ -71,8 +82,46 @@ def launch(
     modelclass: str,
     mode: str = "subprocess",
     rule_kwargs: dict | None = None,
+    supervise: dict | None = None,
 ) -> LaunchHandle:
+    """``mode="supervised"`` (or any ``supervise={...}`` kwargs) wraps
+    the worker subprocess in ``utils.supervisor.Supervisor``: worker
+    exits are classified (clean / preemption-like 137 / crash), hangs
+    are detected by heartbeat stall and killed, and every failure
+    relaunches with ``resume=True`` into the same ``checkpoint_dir``
+    under exponential backoff — no operator in the loop.  ``wait()``
+    then returns the supervision report; the restart budget spending
+    out raises ``SupervisorGaveUp`` (loud, never a silent loop).
+    ``supervise`` keys = ``Supervisor`` kwargs (``max_restarts``,
+    ``stall_timeout_s``, ``backoff_base_s``, ``crash_loop_budget``,
+    ...)."""
     rule_kwargs = dict(rule_kwargs or {})
+    if supervise is None:
+        # rule.init(..., launch="supervised", supervise={...}) arrives
+        # through rule_kwargs — pull it out before it reaches run()
+        supervise = rule_kwargs.pop("supervise", None)
+    if mode == "supervised" or supervise is not None:
+        from theanompi_tpu.utils.supervisor import (
+            Supervisor,
+            make_worker_cmd_factory,
+        )
+
+        checkpoint_dir = rule_kwargs.get("checkpoint_dir")
+        if not checkpoint_dir:
+            raise ValueError(
+                "supervised launch needs rule_kwargs['checkpoint_dir'] "
+                "— relaunch-with-resume is the whole recovery story"
+            )
+        sup = Supervisor(
+            cmd_for=make_worker_cmd_factory(
+                worker_module, devices, modelfile, modelclass,
+                rule_kwargs,
+            ),
+            checkpoint_dir=checkpoint_dir,
+            initial_resume=bool(rule_kwargs.get("resume", False)),
+            **(supervise or {}),
+        )
+        return LaunchHandle(mode="supervised", supervisor=sup)
     if mode == "inprocess":
         result = _run_worker_inprocess(
             worker_module, devices, modelfile, modelclass, rule_kwargs
@@ -147,14 +196,21 @@ def finish_distributed(ok: bool = True) -> None:
     planes shrug off a dead worker); teardown must be too.
 
     Call at the very end of a distributed worker ``__main__``: flushes
-    stdio and ``os._exit``s, skipping the barrier.  Restart tooling
-    judges the run by its checkpoint + exit code, which this makes
+    stdio AND a terminal heartbeat, then ``os._exit``s, skipping the
+    barrier.  The heartbeat stamp is what lets a supervisor
+    distinguish "clean exit" from "died during shutdown" on this
+    no-barrier path — without it an ``os._exit`` and a SIGKILL during
+    teardown look identical.  Restart tooling judges the run by its
+    checkpoint + exit code + final heartbeat, which this makes
     truthful.  No-op under a single process (normal interpreter exit
     is fine there)."""
     import jax
 
     if jax.process_count() <= 1:
         return
+    from theanompi_tpu.utils import supervisor as _sup
+
+    _sup.flush_final_heartbeat(ok=ok)
     sys.stdout.flush()
     sys.stderr.flush()
     os._exit(0 if ok else 1)
@@ -176,7 +232,32 @@ def main(argv: Sequence[str] | None = None) -> int:
     ap.add_argument("--host-id", type=int, default=None)
     ap.add_argument("--kwargs", default="{}",
                     help="JSON dict of extra rule/worker kwargs")
+    ap.add_argument("--supervise", action="store_true",
+                    help="self-healing mode: run the worker under the "
+                    "supervisor (auto-relaunch with resume on "
+                    "crash/preemption, hang watchdog); needs "
+                    "checkpoint_dir in --kwargs")
+    ap.add_argument("--max-restarts", type=int, default=5,
+                    help="supervisor restart budget (with --supervise)")
+    ap.add_argument("--stall-timeout-s", type=float, default=120.0,
+                    help="supervisor hang watchdog: kill + relaunch "
+                    "after this many seconds without a heartbeat "
+                    "(with --supervise)")
     ns = ap.parse_args(argv)
+
+    if ns.supervise and ns.coordinator is not None:
+        # the supervised child is spawned WITHOUT the coordinator
+        # bootstrap, so each host would silently train an independent
+        # single-host replica into the shared checkpoint_dir —
+        # refuse instead of degrading.  Multi-host self-healing =
+        # per-host supervisors under the pod orchestrator's job-level
+        # restart (docs/RESILIENCE.md).
+        ap.error(
+            "--supervise does not compose with --coordinator yet: "
+            "run one supervised tmlauncher per host WITHOUT "
+            "--coordinator, or let the pod orchestrator restart the "
+            "whole job"
+        )
 
     init_distributed(ns.coordinator, ns.num_hosts, ns.host_id)
 
@@ -184,11 +265,18 @@ def main(argv: Sequence[str] | None = None) -> int:
 
     rule = getattr(tm, ns.rule)()
     devices = list(range(ns.devices)) if ns.devices is not None else None
+    extra: dict = {}
+    if ns.supervise:
+        extra["supervise"] = {
+            "max_restarts": ns.max_restarts,
+            "stall_timeout_s": ns.stall_timeout_s,
+        }
     rule.init(
         devices=devices,
         modelfile=ns.modelfile,
         modelclass=ns.modelclass,
-        launch="inprocess",
+        launch="supervised" if ns.supervise else "inprocess",
+        **extra,
         **json.loads(ns.kwargs),
     )
     rule.wait()

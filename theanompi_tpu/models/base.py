@@ -160,10 +160,26 @@ class TMModel:
                 return "sharded"
         return "npz"
 
-    def save(self, directory: str, recorder: Recorder | None = None) -> None:
+    def save(
+        self,
+        directory: str,
+        recorder: Recorder | None = None,
+        extra_meta: dict | None = None,
+    ) -> None:
+        """``extra_meta`` rides in the sidecar — the graceful-
+        preemption path stamps ``next_iter`` so a mid-epoch checkpoint
+        resumes at the exact boundary instead of redoing (or worse,
+        skipping) the epoch.  ``config['keep_last_checkpoints']``
+        bounds on-disk history for supervised many-restart runs."""
         meta = {"epoch": self.epoch, "lr": self.current_lr}
         if recorder is not None:
             meta["recorder"] = recorder.state_dict()
+        if extra_meta:
+            meta.update(extra_meta)
+        keep_last = getattr(self, "config", {}).get(
+            "keep_last_checkpoints"
+        )
+        keep_last = int(keep_last) if keep_last is not None else None
         # zero1 optimizer shards are flat buffers whose INTERNAL order
         # depends on the bucket layout (bucket-major when bucketed) —
         # stamp it so a resume under a different exchange_bucket_mb
@@ -174,12 +190,23 @@ class TMModel:
             meta["zero1_layout"] = list(z_layout)
         trees = self.checkpoint_trees()
         if self._checkpoint_format(trees) == "sharded":
-            save_sharded_checkpoint(directory, self.epoch, trees, meta)
+            save_sharded_checkpoint(
+                directory, self.epoch, trees, meta, keep_last=keep_last
+            )
         else:
-            save_checkpoint(directory, self.epoch, trees, meta)
+            save_checkpoint(
+                directory, self.epoch, trees, meta, keep_last=keep_last
+            )
 
     def load(self, directory: str, recorder: Recorder | None = None) -> bool:
-        path = latest_checkpoint(directory)
+        # validate by default: a post-commit bit flip must fall back
+        # to the previous valid checkpoint (quarantining the corrupt
+        # one), never load blindly.  config['validate_checkpoint']=False
+        # opts out (e.g. enormous sharded trees on a trusted store).
+        validate = bool(
+            getattr(self, "config", {}).get("validate_checkpoint", True)
+        )
+        path = latest_checkpoint(directory, validate=validate)
         if path is None:
             return False
         if is_sharded_checkpoint(path):
@@ -209,6 +236,10 @@ class TMModel:
                     f"was trained with"
                 )
         self._restored_zero1_layout = meta.get("zero1_layout")
+        # workers read this for resilience metadata the load() bool
+        # can't carry: next_iter (mid-epoch preemption checkpoints),
+        # preempted flag, restored recorder history
+        self.restored_meta = meta
         for group, tree in trees.items():
             setattr(self, group, tree)
         # compile_iter_fns consults this: compiling with a zero1

@@ -6,7 +6,10 @@ TPU-native rebuild of ``theanompi/lib/{recorder,helper_funcs}.py``.
 from theanompi_tpu.utils.checkpoint import (
     latest_checkpoint,
     load_checkpoint,
+    prune_checkpoints,
+    quarantine_checkpoint,
     save_checkpoint,
+    verify_checkpoint,
 )
 from theanompi_tpu.utils.compile_cache import enable_compile_cache
 from theanompi_tpu.utils.recorder import Recorder
@@ -14,15 +17,23 @@ from theanompi_tpu.utils.sharded_checkpoint import (
     is_sharded_checkpoint,
     load_sharded_checkpoint,
     save_sharded_checkpoint,
+    verify_sharded_checkpoint,
 )
+from theanompi_tpu.utils.supervisor import Supervisor, SupervisorGaveUp
 
 __all__ = [
     "Recorder",
+    "Supervisor",
+    "SupervisorGaveUp",
     "enable_compile_cache",
     "save_checkpoint",
     "load_checkpoint",
     "latest_checkpoint",
+    "verify_checkpoint",
+    "quarantine_checkpoint",
+    "prune_checkpoints",
     "save_sharded_checkpoint",
     "load_sharded_checkpoint",
     "is_sharded_checkpoint",
+    "verify_sharded_checkpoint",
 ]

@@ -10,6 +10,15 @@ sidecar with scalar metadata (epoch, lr, recorder state).  Works for
 any pytree the models produce, is single-file-per-step (atomic rename)
 and host-portable.  Orbax remains available for sharded multi-host
 checkpoints; this module is the dependency-free core path.
+
+Resilience (PR 3): the sidecar also stamps a per-array content digest
+(crc32) at save time, so a checkpoint corrupted AFTER commit (bit
+flip, truncation, torn disk) is detectable — ``verify_checkpoint``
+re-hashes, ``latest_checkpoint(validate=True)`` probes newest-first
+and falls back to the newest checkpoint that passes, QUARANTINING a
+corrupt one (renamed ``*.corrupt``, never deleted — post-mortem
+evidence).  ``keep_last=`` bounds disk growth for supervised runs
+that checkpoint through many restarts.
 """
 
 from __future__ import annotations
@@ -17,6 +26,8 @@ from __future__ import annotations
 import json
 import os
 import re
+import shutil
+import zlib
 from pathlib import Path
 from typing import Any
 
@@ -24,6 +35,12 @@ import jax
 import numpy as np
 
 PyTree = Any
+
+#: sidecar keys internal to the checkpoint machinery — stripped from
+#: the metadata handed back to callers
+_INTERNAL_META = ("_digests",)
+
+_CKPT_RE = re.compile(r"ckpt_(\d+)(\.npz|\.shards)")
 
 
 def _leaf_names(tree) -> list[str]:
@@ -53,13 +70,28 @@ def dict_to_tree(d: dict[str, np.ndarray], like: PyTree) -> PyTree:
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
+def array_digest(arr: np.ndarray) -> int:
+    """Content digest of one array: crc32 over raw bytes + shape/dtype
+    (fast enough to run at save AND load; catches bit flips and
+    truncation, which is the post-commit threat model — not an
+    adversary)."""
+    arr = np.ascontiguousarray(arr)
+    header = f"{arr.dtype.str}:{arr.shape}".encode()
+    return zlib.crc32(arr.tobytes(), zlib.crc32(header)) & 0xFFFFFFFF
+
+
 def save_checkpoint(
     directory: str | Path,
     step: int,
     trees: dict[str, PyTree],
     meta: dict | None = None,
+    keep_last: int | None = None,
 ) -> Path:
-    """Write ``{directory}/ckpt_{step}.npz`` (+ ``.json`` metadata)."""
+    """Write ``{directory}/ckpt_{step}.npz`` (+ ``.json`` metadata,
+    which always carries per-array digests for post-commit corruption
+    detection).  ``keep_last`` prunes older checkpoints past the
+    newest N (never the one just written; quarantined ``*.corrupt``
+    evidence is never touched)."""
     directory = Path(directory)
     directory.mkdir(parents=True, exist_ok=True)
     flat: dict[str, np.ndarray] = {}
@@ -69,13 +101,16 @@ def save_checkpoint(
     # meta lands before the npz is renamed into place: a crash in
     # between leaves stray files but never a discoverable checkpoint
     # with missing metadata (which would silently resume at epoch 0).
-    if meta is not None:
-        (directory / f"ckpt_{step}.json").write_text(json.dumps(meta))
+    sidecar = dict(meta or {})
+    sidecar["_digests"] = {k: array_digest(v) for k, v in flat.items()}
+    (directory / f"ckpt_{step}.json").write_text(json.dumps(sidecar))
     tmp = directory / f".ckpt_{step}.npz.tmp"
     final = directory / f"ckpt_{step}.npz"
     with open(tmp, "wb") as f:
         np.savez(f, **flat)
     os.replace(tmp, final)
+    if keep_last is not None:
+        prune_checkpoints(directory, keep_last, protect={final})
     return final
 
 
@@ -97,18 +132,73 @@ def load_checkpoint(
         out[group] = dict_to_tree(sub, tree)
     meta_path = path.with_suffix(".json")
     meta = json.loads(meta_path.read_text()) if meta_path.exists() else {}
+    for k in _INTERNAL_META:
+        meta.pop(k, None)
     return out, meta
 
 
-def latest_checkpoint(directory: str | Path) -> Path | None:
-    """Newest checkpoint in ``directory`` — either format (npz file or
-    ``.shards`` dir from ``sharded_checkpoint``)."""
-    directory = Path(directory)
-    if not directory.is_dir():
-        return None
-    best, best_key = None, (-1, -1.0)
+def verify_checkpoint(path: str | Path) -> bool:
+    """Deep-probe one committed checkpoint: structurally readable AND
+    every array matches its save-time digest.  Checkpoints from before
+    digest stamping verify structurally only.  Never raises — any
+    failure to read is a failed verification."""
+    path = Path(path)
+    try:
+        if path.name.endswith(".shards"):
+            from theanompi_tpu.utils.sharded_checkpoint import (
+                verify_sharded_checkpoint,
+            )
+
+            return verify_sharded_checkpoint(path)
+        digests: dict = {}
+        meta_path = path.with_suffix(".json")
+        if meta_path.exists():
+            digests = json.loads(meta_path.read_text()).get(
+                "_digests", {}
+            ) or {}
+        with np.load(path) as z:
+            names = set(z.files)
+            if digests and set(digests) != names:
+                return False  # missing/extra member = truncation/mixup
+            for k in z.files:
+                arr = z[k]  # decompress/read — corrupt zips raise here
+                if digests and array_digest(arr) != int(digests[k]):
+                    return False
+        return True
+    except Exception:
+        return False
+
+
+def quarantine_checkpoint(path: str | Path) -> Path:
+    """Rename a corrupt checkpoint (and its sidecar) to ``*.corrupt``
+    — undiscoverable by ``latest_checkpoint`` but preserved on disk
+    for post-mortem.  Never deletes."""
+    path = Path(path)
+    dst = path.with_name(path.name + ".corrupt")
+    n = 0
+    while dst.exists():  # repeat corruption of the same step
+        n += 1
+        dst = path.with_name(f"{path.name}.corrupt{n}")
+    os.replace(path, dst)
+    if path.suffix == ".npz":
+        sidecar = path.with_suffix(".json")
+        if sidecar.exists():
+            os.replace(
+                sidecar,
+                sidecar.with_name(sidecar.name + (
+                    f".corrupt{n}" if n else ".corrupt"
+                )),
+            )
+    return dst
+
+
+def _candidates(directory: Path) -> list[Path]:
+    """Committed checkpoints in ``directory``, newest first — by
+    (step, mtime): same step in both formats (e.g. replicated rerun
+    of a sharded run) prefers the newer write, not iteration order."""
+    found = []
     for p in directory.iterdir():
-        m = re.fullmatch(r"ckpt_(\d+)(\.npz|\.shards)", p.name)
+        m = _CKPT_RE.fullmatch(p.name)
         if not m:
             continue
         if m.group(2) == ".shards":
@@ -118,9 +208,64 @@ def latest_checkpoint(directory: str | Path) -> Path | None:
 
             if not is_sharded_checkpoint(p):
                 continue  # uncommitted partial save
-        # same step in both formats (e.g. replicated rerun of a
-        # sharded run): prefer the newer write, not iteration order
-        key = (int(m.group(1)), p.stat().st_mtime)
-        if key > best_key:
-            best, best_key = p, key
-    return best
+        found.append((int(m.group(1)), p.stat().st_mtime, p))
+    found.sort(key=lambda t: (t[0], t[1]), reverse=True)
+    return [p for _, _, p in found]
+
+
+def latest_checkpoint(
+    directory: str | Path, validate: bool = False
+) -> Path | None:
+    """Newest checkpoint in ``directory`` — either format (npz file or
+    ``.shards`` dir from ``sharded_checkpoint``).
+
+    ``validate=True`` deep-probes candidates newest-first
+    (``verify_checkpoint``) and returns the newest one that PASSES;
+    a corrupt candidate is quarantined (renamed ``*.corrupt``, never
+    deleted) so a resume falls back to the previous valid checkpoint
+    instead of dying or silently diverging on a post-commit bit
+    flip."""
+    directory = Path(directory)
+    if not directory.is_dir():
+        return None
+    for p in _candidates(directory):
+        if not validate:
+            return p
+        if verify_checkpoint(p):
+            return p
+        q = quarantine_checkpoint(p)
+        print(
+            f"checkpoint: {p.name} failed validation — quarantined as "
+            f"{q.name}, falling back to the previous checkpoint",
+            flush=True,
+        )
+    return None
+
+
+def prune_checkpoints(
+    directory: str | Path,
+    keep_last: int,
+    protect: set[Path] | None = None,
+) -> list[Path]:
+    """Delete committed checkpoints beyond the newest ``keep_last``
+    (disk bound for supervised runs that restart many times).  The
+    just-written checkpoint must be passed via ``protect`` by savers;
+    quarantined ``*.corrupt`` files never match and are never
+    collected.  Returns the deleted paths."""
+    if keep_last < 1:
+        raise ValueError(f"keep_last must be >= 1, got {keep_last}")
+    directory = Path(directory)
+    protect = {Path(p) for p in (protect or set())}
+    removed: list[Path] = []
+    for p in _candidates(directory)[keep_last:]:
+        if p in protect:
+            continue
+        if p.is_dir():
+            shutil.rmtree(p)
+        else:
+            p.unlink()
+            sidecar = p.with_suffix(".json")
+            if sidecar.exists():
+                sidecar.unlink()
+        removed.append(p)
+    return removed

@@ -1,53 +1,226 @@
 """Deterministic fault injection (SURVEY §5.3 failure recovery).
 
 The reference's failure story was "checkpoint every epoch, restart
-from the last one"; proving the rebuild honors it needs a
-reproducible mid-run death.  ``TM_FAULT_AT="<epoch>:<iter>"`` makes
-any worker loop die via ``os._exit(137)`` — no atexit, no buffered
-checkpoint flush, indistinguishable from a SIGKILL/preemption — right
-after that training iteration completes.
+from the last one"; proving the rebuild honors it — and that the
+supervisor (``utils/supervisor.py``) closes the loop without an
+operator — needs reproducible mid-run failures of every kind the
+fleet actually sees.  ``TM_FAULT_AT`` names them:
+
+    TM_FAULT_AT="<epoch>:<iter>[:<action>][,<epoch>:<iter>[:<action>]...]"
+
+with actions
+
+- ``die`` (default) — ``os._exit(137)``: no atexit, no buffered
+  checkpoint flush, indistinguishable from a SIGKILL/preemption,
+- ``hang`` — stop making progress forever (a stuck collective /
+  dead peer); only the supervisor's stall watchdog can end it,
+- ``sigterm`` — raise SIGTERM in-process: the worker's graceful
+  preemption handler checkpoints at the boundary and exits cleanly,
+- ``corrupt_ckpt`` — flip bytes in the newest COMMITTED checkpoint
+  (a post-commit bit-flip / truncated write), then die like a
+  preemption: the relaunch must detect, quarantine, and fall back.
+
+A fault fires at most ONCE.  Under a supervisor the relaunched
+process would otherwise re-read the same env and re-die at the same
+step forever, so fired faults are persisted to the ``TM_FAULT_STATE``
+file (one index per line, written BEFORE the fault executes); without
+that env the fired set is process-local, preserving the original
+single-fault manual-rerun drill.
 
 Workers call ``maybe_inject_fault(epoch, i)`` once per iteration; the
-env read is cached so the hot loop pays one string compare.
+env read is cached so the hot loop pays one comparison
+(``reset_fault_cache()`` drops the cache so one process can exercise
+several configs, e.g. in tests).
 """
 
 from __future__ import annotations
 
 import os
+import signal
+import time
+from pathlib import Path
 
 _ENV = "TM_FAULT_AT"
-_parsed: tuple[int, int] | None | str = "unset"
+_STATE_ENV = "TM_FAULT_STATE"
+
+ACTIONS = ("die", "hang", "sigterm", "corrupt_ckpt")
+
+#: parsed fault list — ``"unset"`` sentinel until first read, then
+#: ``None`` (no faults) or a list of ``(epoch, iter, action)``
+_parsed: list[tuple[int, int, str]] | None | str = "unset"
+_fired: set[int] = set()
 
 
-def _target() -> tuple[int, int] | None:
-    global _parsed
+def reset_fault_cache() -> None:
+    """Forget the cached ``TM_FAULT_AT`` parse AND the in-process
+    fired set, so one process can exercise multiple fault configs
+    (tests; parameter sweeps re-entering ``run()``)."""
+    global _parsed, _fired
+    _parsed = "unset"
+    _fired = set()
+
+
+def _parse_one(entry: str) -> tuple[int, int, str]:
+    parts = entry.split(":")
+    if len(parts) == 2:
+        e, i = parts
+        action = "die"
+    elif len(parts) == 3:
+        e, i, action = parts
+    else:
+        raise ValueError(entry)
+    if action not in ACTIONS:
+        raise ValueError(entry)
+    return (int(e), int(i), action)
+
+
+def _target() -> list[tuple[int, int, str]] | None:
+    global _parsed, _fired
     if _parsed == "unset":
         raw = os.environ.get(_ENV)
         if not raw:
             _parsed = None
         else:
             try:
-                e, i = raw.split(":")
-                _parsed = (int(e), int(i))
+                _parsed = [
+                    _parse_one(s.strip())
+                    for s in raw.split(",") if s.strip()
+                ]
             except ValueError as err:
                 raise ValueError(
-                    f"{_ENV} must be '<epoch>:<iter>', got {raw!r}"
+                    f"{_ENV} must be "
+                    f"'<epoch>:<iter>[:die|hang|sigterm|corrupt_ckpt]"
+                    f"[,...]', got {raw!r}"
                 ) from err
-    return _parsed
+            if not _parsed:
+                _parsed = None
+            _fired |= _load_state()
+    return _parsed  # type: ignore[return-value]
 
 
-def maybe_inject_fault(epoch: int, i: int, i_last: int | None = None) -> None:
-    """Die like a preempted process if ``TM_FAULT_AT`` targets
-    ``epoch`` and an iteration in ``[i, i_last]`` (``i_last`` defaults
-    to ``i``; chunked dispatch loops pass the whole range so a target
-    inside a multi-step chunk still fires)."""
-    t = _target()
-    if t is None:
+# -- fired-state persistence (supervised relaunches) -------------------------
+
+def _state_file() -> Path | None:
+    p = os.environ.get(_STATE_ENV)
+    return Path(p) if p else None
+
+
+def _load_state() -> set[int]:
+    f = _state_file()
+    if f is None or not f.exists():
+        return set()
+    out = set()
+    for line in f.read_text().splitlines():
+        line = line.strip()
+        if line.isdigit():
+            out.add(int(line))
+    return out
+
+
+def _mark_fired(idx: int) -> None:
+    """Record BEFORE executing: a die/hang between write and action
+    must still count as fired on the next launch."""
+    _fired.add(idx)
+    f = _state_file()
+    if f is None:
+        return
+    f.parent.mkdir(parents=True, exist_ok=True)
+    with open(f, "a") as fh:
+        fh.write(f"{idx}\n")
+        fh.flush()
+        os.fsync(fh.fileno())
+
+
+# -- fault actions -----------------------------------------------------------
+
+def _corrupt_file(target: Path) -> None:
+    size = target.stat().st_size
+    with open(target, "r+b") as f:
+        if size < 32:
+            f.truncate(max(0, size // 2))  # tiny file: truncate instead
+            return
+        off = max(0, size // 2 - 8)
+        f.seek(off)
+        chunk = f.read(16)
+        f.seek(off)
+        f.write(bytes(b ^ 0xFF for b in chunk))
+
+
+def _corrupt_latest_checkpoint(checkpoint_dir: str) -> str:
+    from theanompi_tpu.utils.checkpoint import latest_checkpoint
+
+    path = latest_checkpoint(checkpoint_dir)
+    if path is None:
+        raise RuntimeError(
+            f"{_ENV}: corrupt_ckpt fired but {checkpoint_dir!r} holds "
+            f"no committed checkpoint to corrupt"
+        )
+    if path.is_dir():  # .shards: hit the largest data shard
+        npys = sorted(
+            (p for p in path.iterdir() if p.suffix == ".npy"),
+            key=lambda p: p.stat().st_size, reverse=True,
+        )
+        if not npys:
+            raise RuntimeError(f"{_ENV}: no shard files in {path}")
+        _corrupt_file(npys[0])
+    else:
+        _corrupt_file(path)
+    return str(path)
+
+
+def _execute(action: str, epoch: int, it: int,
+             checkpoint_dir: str | None) -> None:
+    print(
+        f"{_ENV}: injecting fault at epoch {epoch} iter {it}"
+        + (f" ({action})" if action != "die" else ""),
+        flush=True,
+    )
+    if action == "die":
+        os._exit(137)
+    if action == "hang":
+        # a stuck collective: alive but never progressing — only a
+        # stall watchdog ends this (SIGKILL; no handler could run)
+        while True:
+            time.sleep(3600)
+    if action == "sigterm":
+        # planned preemption: the worker's graceful handler (installed
+        # by utils/supervisor.install_preemption_handler) sets the
+        # flag; the loop checkpoints at this boundary and exits 0
+        signal.raise_signal(signal.SIGTERM)
+        return
+    if action == "corrupt_ckpt":
+        if not checkpoint_dir:
+            raise RuntimeError(
+                f"{_ENV}: corrupt_ckpt needs the worker's "
+                f"checkpoint_dir (pass checkpoint_dir= to "
+                f"maybe_inject_fault, or run with a checkpoint_dir)"
+            )
+        where = _corrupt_latest_checkpoint(checkpoint_dir)
+        print(f"{_ENV}: corrupted committed checkpoint {where}",
+              flush=True)
+        os._exit(137)
+    raise AssertionError(action)
+
+
+def maybe_inject_fault(
+    epoch: int,
+    i: int,
+    i_last: int | None = None,
+    checkpoint_dir: str | None = None,
+) -> None:
+    """Fire the first not-yet-fired fault targeting ``epoch`` and an
+    iteration in ``[i, i_last]`` (``i_last`` defaults to ``i``;
+    chunked dispatch loops pass the whole range so a target inside a
+    multi-step chunk still fires).  ``checkpoint_dir`` feeds the
+    ``corrupt_ckpt`` action."""
+    faults = _target()
+    if not faults:
         return
     hi = i if i_last is None else i_last
-    if t[0] == epoch and i <= t[1] <= hi:
-        print(
-            f"TM_FAULT_AT: injecting fault at epoch {epoch} iter {t[1]}",
-            flush=True,
-        )
-        os._exit(137)
+    for idx, (e, it, action) in enumerate(faults):
+        if idx in _fired:
+            continue
+        if e == epoch and i <= it <= hi:
+            _mark_fired(idx)
+            _execute(action, epoch, it, checkpoint_dir)
+            return  # sigterm returns; one fault per boundary

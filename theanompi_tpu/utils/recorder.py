@@ -58,6 +58,12 @@ class Recorder:
         self._pending: list[tuple] = []  # unread device scalars (lazy fence)
         self.n_iter = 0
         self._last_print = 0
+        # resilience bookkeeping (utils/supervisor.py): one entry per
+        # supervised relaunch this run descends from — cause,
+        # resumed-from step, recovery latency.  Persisted through
+        # checkpoints so the FINAL summary shows the whole run's
+        # restart history, not just the last process's.
+        self.restart_events: list[dict] = []
 
     # -- wall-clock segments (reference: start()/end(mode)) ---------------
 
@@ -154,6 +160,50 @@ class Recorder:
         self._window = []
         self.segments = {m: 0.0 for m in MODES}
 
+    def record_restart(
+        self,
+        cause: str,
+        resumed_epoch: int | None = None,
+        resumed_iter: int | None = None,
+        recovery_s: float | None = None,
+        restart: int | None = None,
+    ) -> None:
+        """One supervised relaunch: why the previous incarnation died,
+        where this one resumed, and the worker-side recovery latency
+        (failure detection → restored and ready to train)."""
+        self.restart_events.append({
+            "restart": (
+                restart if restart is not None
+                else len(self.restart_events) + 1
+            ),
+            "cause": cause,
+            "resumed_epoch": resumed_epoch,
+            "resumed_iter": resumed_iter,
+            "recovery_s": recovery_s,
+        })
+        if self.verbose:
+            at = (
+                f"epoch {resumed_epoch}"
+                + (f" iter {resumed_iter}" if resumed_iter else "")
+                if resumed_epoch is not None else "scratch"
+            )
+            rec = f" after {recovery_s:.1f}s" if recovery_s else ""
+            print(
+                f"restart #{self.restart_events[-1]['restart']}: "
+                f"cause={cause}, resumed from {at}{rec}",
+                flush=True,
+            )
+
+    @property
+    def mttr_s(self) -> float | None:
+        """Mean time-to-recovery over recorded restarts (None until a
+        recovery has been measured)."""
+        rs = [
+            e["recovery_s"] for e in self.restart_events
+            if e.get("recovery_s") is not None
+        ]
+        return sum(rs) / len(rs) if rs else None
+
     def val_error(self, loss: float, err: float, err_top5: float | None = None) -> None:
         rec = {"loss": float(loss), "err": float(err)}
         if err_top5 is not None:
@@ -208,6 +258,7 @@ class Recorder:
             "val_records": self.val_records,
             "epoch_times": self.epoch_times,
             "n_iter": self.n_iter,
+            "restart_events": self.restart_events,
         }
 
     def save(self, path: str | Path) -> None:
@@ -220,6 +271,8 @@ class Recorder:
         self.val_records = list(d["val_records"])
         self.epoch_times = list(d["epoch_times"])
         self.n_iter = int(d["n_iter"])
+        # absent in pre-resilience checkpoints
+        self.restart_events = list(d.get("restart_events", []))
         self._last_print = self.n_iter
 
     def load(self, path: str | Path) -> None:

@@ -19,6 +19,7 @@ from typing import Any, Sequence
 from theanompi_tpu import launcher as _launcher
 from theanompi_tpu.parallel import default_devices, dp_replicas, make_mesh
 from theanompi_tpu.utils import Recorder, faults as _faults
+from theanompi_tpu.utils import supervisor as _sup
 
 
 def _resolve_model(modelfile: str, modelclass: str):
@@ -104,11 +105,12 @@ def run(
     recorder = Recorder(
         rank=0, size=n_replicas, print_freq=print_freq, verbose=verbose
     )
-    if resume and checkpoint_dir:
-        if model.load(checkpoint_dir, recorder):
-            model.epoch += 1  # saved after finishing that epoch
-            if verbose:
-                print(f"resumed from epoch {model.epoch - 1}", flush=True)
+    # graceful preemption: SIGTERM → checkpoint at the next iteration
+    # boundary (meta stamps next_iter) and exit 0 — a planned
+    # preemption loses zero steps instead of the whole epoch
+    start_iter, resumed_from = _sup.begin_resilient_run(
+        model, recorder, checkpoint_dir, resume, verbose=verbose
+    )
 
     data = model.data
     if verbose:
@@ -122,13 +124,17 @@ def run(
             flush=True,
         )
 
+    preempted = False
+    i = 0
     while model.epoch < model.n_epochs:
         epoch = model.epoch
         recorder.start_epoch()
         if hasattr(data, "shuffle"):
-            data.shuffle(epoch)
+            data.shuffle(epoch)  # same epoch → same permutation, so a
+            # mid-epoch resume continues the identical batch sequence
         nb = data.n_batch_train
-        i = 0
+        i = start_iter
+        start_iter = 0
         while i < nb:
             # device-resident models batch K steps per dispatch
             # (steps_per_call config knob); everything else is the
@@ -141,7 +147,15 @@ def run(
                 model.train_iter(i, recorder)
             i += k
             recorder.print_train_info(i - 1)
-            _faults.maybe_inject_fault(epoch, i - k, i - 1)
+            _faults.maybe_inject_fault(epoch, i - k, i - 1,
+                                       checkpoint_dir=checkpoint_dir)
+            _sup.heartbeat(recorder.n_iter, epoch, i - 1,
+                           resumed_from=resumed_from)
+            if _sup.preemption_requested():
+                preempted = True
+                break
+        if preempted:
+            break
 
         if data.n_batch_val:
             tot_l = tot_e = tot_e5 = 0.0
@@ -168,6 +182,24 @@ def run(
             model.save(checkpoint_dir, recorder)
         model.epoch += 1
 
+    if preempted:
+        if checkpoint_dir:
+            recorder.flush()  # fence in-flight steps before the save
+            model.save(checkpoint_dir, recorder,
+                       extra_meta={"next_iter": i, "preempted": True})
+        if verbose:
+            print(
+                f"preempted: checkpointed epoch {model.epoch} iter {i}, "
+                f"exiting cleanly", flush=True,
+            )
+        _sup.heartbeat(recorder.n_iter, model.epoch, i,
+                       status="preempted")
+    else:
+        _sup.heartbeat(recorder.n_iter, model.epoch, None,
+                       status="completed")
+    # give an in-process host its normal SIGTERM semantics back
+    _sup.uninstall_preemption_handler()
+
     last_val = recorder.val_records[-1] if recorder.val_records else {}
     return {
         "epochs": model.epoch,
@@ -179,6 +211,11 @@ def run(
         ),
         "final_val": last_val,
         "epoch_times": recorder.epoch_times,
+        "preempted": preempted,
+        "resumed_from": resumed_from,
+        "restarts": recorder.restart_events,
+        "n_restarts": len(recorder.restart_events),
+        "mttr_s": recorder.mttr_s,
         "recorder": recorder,
         "model": model,
     }

@@ -39,6 +39,7 @@ from theanompi_tpu.parallel import (
     elastic_center_merge_masked,
 )
 from theanompi_tpu.utils import Recorder, faults as _faults
+from theanompi_tpu.utils import supervisor as _sup
 from theanompi_tpu.workers.bsp_worker import _build_mesh, _resolve_model
 from theanompi_tpu.workers.replica_engine import ReplicaEngine
 
@@ -148,13 +149,14 @@ def run(
     recorder = Recorder(
         rank=0, size=n_workers, print_freq=print_freq, verbose=verbose
     )
-    if resume and checkpoint_dir:
-        if model.load(checkpoint_dir, recorder):
-            model.epoch += 1
-            if verbose:
-                print(f"resumed from epoch {model.epoch - 1}", flush=True)
+    # mid-epoch resumes restart from the center-adopted checkpoint;
+    # out-of-step speed credits restart at zero — a small perturbation
+    # of an already-asynchronous schedule
+    start_iter, resumed_from = _sup.begin_resilient_run(
+        model, recorder, checkpoint_dir, resume, verbose=verbose
+    )
 
-    # ReplicaEngine stacks model.params — which model.load() above has
+    # ReplicaEngine stacks model.params — which the load above has
     # already replaced on resume, so workers restart from the restored
     # center (with the checkpointed consensus momentum) automatically.
     engine = ReplicaEngine(model, mesh)
@@ -211,12 +213,14 @@ def run(
         model.net_state = engine.mean_net_state()
         model.opt_state = engine.mean_opt_state()
 
+    preempted = False
+    i = 0
     while model.epoch < model.n_epochs:
         epoch = model.epoch
         recorder.start_epoch()
         if hasattr(data, "shuffle"):
             data.shuffle(epoch)
-        for i in range(data.n_batch_train):
+        for i in range(start_iter, data.n_batch_train):
             recorder.start()
             batch = data.train_batch(i)
             recorder.end("wait")
@@ -271,7 +275,16 @@ def run(
                     since_exchange[exch] = 0
                     n_exchanges += int(exch.sum())
             recorder.print_train_info(i)
-            _faults.maybe_inject_fault(epoch, i)
+            _faults.maybe_inject_fault(epoch, i,
+                                       checkpoint_dir=checkpoint_dir)
+            _sup.heartbeat(recorder.n_iter, epoch, i,
+                           resumed_from=resumed_from)
+            if _sup.preemption_requested():
+                preempted = True
+                break
+        start_iter = 0
+        if preempted:
+            break
 
         if data.n_batch_val:
             # server semantics: validate the CENTER weights
@@ -290,7 +303,23 @@ def run(
             model.save(checkpoint_dir, recorder)
         model.epoch += 1
 
-    _adopt_center()  # final weights = center + consensus momentum
+    _adopt_center()  # final/preempted weights = center + momentum
+
+    if preempted:
+        if checkpoint_dir:
+            model.save(checkpoint_dir, recorder,
+                       extra_meta={"next_iter": i + 1, "preempted": True})
+        if verbose:
+            print(
+                f"preempted: checkpointed epoch {model.epoch} iter "
+                f"{i + 1}, exiting cleanly", flush=True,
+            )
+        _sup.heartbeat(recorder.n_iter, model.epoch, i,
+                       status="preempted")
+    else:
+        _sup.heartbeat(recorder.n_iter, model.epoch, None,
+                       status="completed")
+    _sup.uninstall_preemption_handler()
 
     last_val = recorder.val_records[-1] if recorder.val_records else {}
     out = {
@@ -302,6 +331,11 @@ def run(
         ),
         "final_val": last_val,
         "epoch_times": recorder.epoch_times,
+        "preempted": preempted,
+        "resumed_from": resumed_from,
+        "restarts": recorder.restart_events,
+        "n_restarts": len(recorder.restart_events),
+        "mttr_s": recorder.mttr_s,
         "recorder": recorder,
         "model": model,
     }
@@ -356,12 +390,13 @@ def _run_distributed(
     recorder = Recorder(
         rank=pid, size=n_procs, print_freq=print_freq, verbose=verbose
     )
-    if resume and checkpoint_dir:
-        # EVERY process loads (checkpoint_dir must be on a shared
-        # filesystem, the standard pod setup) so all workers agree on
-        # the restored epoch and start from the center weights
-        if model.load(checkpoint_dir, recorder):
-            model.epoch += 1
+    # EVERY process loads (checkpoint_dir must be on a shared
+    # filesystem, the standard pod setup) so all workers agree on the
+    # restored epoch and start from the center weights
+    start_iter, resumed_from = _sup.begin_resilient_run(
+        model, recorder, checkpoint_dir, resume,
+        verbose=verbose and pid == 0,
+    )
 
     server = None
     if pid == 0:
@@ -409,6 +444,7 @@ def _run_distributed(
 
     step = 0
     n_exchanges = 0
+    preempted = False
     center_vals: list[dict] = []
     center_stats: dict | None = None
     while model.epoch < model.n_epochs:
@@ -416,7 +452,7 @@ def _run_distributed(
         recorder.start_epoch()
         if hasattr(data, "shuffle"):
             data.shuffle(epoch + pid * 7919)  # decorrelate worker data
-        for i in range(data.n_batch_train):
+        for i in range(start_iter, data.n_batch_train):
             model.train_iter(i, recorder)
             step += 1
             if step % tau == 0:
@@ -431,7 +467,19 @@ def _run_distributed(
                 recorder.end("comm")
                 n_exchanges += 1
             recorder.print_train_info(i)
-            _faults.maybe_inject_fault(epoch, i)
+            _faults.maybe_inject_fault(epoch, i,
+                                       checkpoint_dir=checkpoint_dir)
+            _sup.heartbeat(recorder.n_iter, epoch, i,
+                           resumed_from=resumed_from)
+            if _sup.preemption_requested():
+                preempted = True
+                break
+        start_iter = 0
+        if preempted:
+            # drain gracefully through the normal teardown: announce
+            # stop to the center, let it checkpoint the center weights
+            # (with next_iter so the relaunch continues mid-epoch)
+            break
 
         if data.n_batch_val:
             vals = [model.val_iter(j, recorder)
@@ -505,7 +553,13 @@ def _run_distributed(
             center, jax.tree.map(lambda x: x.sharding, model.params)
         )
         if checkpoint_dir:
-            model.save(checkpoint_dir, recorder)
+            model.save(
+                checkpoint_dir, recorder,
+                extra_meta=(
+                    {"next_iter": i + 1, "preempted": True}
+                    if preempted else None
+                ),
+            )
         center_stats = server.stats()
         if verbose:
             print(
@@ -517,11 +571,21 @@ def _run_distributed(
             )
         server.stop()
 
+    _sup.heartbeat(
+        recorder.n_iter, model.epoch, None,
+        status="preempted" if preempted else "completed",
+    )
+    _sup.uninstall_preemption_handler()
     last_val = recorder.val_records[-1] if recorder.val_records else {}
     return {
         "epochs": model.epoch,
         "iterations": recorder.n_iter,
         "exchanges": n_exchanges,
+        "preempted": preempted,
+        "resumed_from": resumed_from,
+        "restarts": recorder.restart_events,
+        "n_restarts": len(recorder.restart_events),
+        "mttr_s": recorder.mttr_s,
         "process_index": pid,
         "final_train_loss": (
             recorder.train_losses[-1] if recorder.train_losses else None

@@ -38,6 +38,7 @@ import numpy as np
 from theanompi_tpu import launcher as _launcher
 from theanompi_tpu.parallel import gossip_matrix_round
 from theanompi_tpu.utils import Recorder, faults as _faults
+from theanompi_tpu.utils import supervisor as _sup
 from theanompi_tpu.workers.bsp_worker import _build_mesh, _resolve_model
 from theanompi_tpu.workers.replica_engine import ReplicaEngine
 
@@ -132,11 +133,11 @@ def run(
     recorder = Recorder(
         rank=0, size=n_workers, print_freq=print_freq, verbose=verbose
     )
-    if resume and checkpoint_dir:
-        if model.load(checkpoint_dir, recorder):
-            model.epoch += 1
-            if verbose:
-                print(f"resumed from epoch {model.epoch - 1}", flush=True)
+    # mid-epoch resumes restart every replica from the adopted
+    # best-score checkpoint; scores re-level from uniform
+    start_iter, resumed_from = _sup.begin_resilient_run(
+        model, recorder, checkpoint_dir, resume, verbose=verbose
+    )
 
     # ReplicaEngine stacks model.params — already the restored
     # consensus weights on resume, so no re-broadcast is needed.
@@ -193,12 +194,14 @@ def run(
         )
 
     n_rounds = 0
+    preempted = False
+    i = 0
     while model.epoch < model.n_epochs:
         epoch = model.epoch
         recorder.start_epoch()
         if hasattr(data, "shuffle"):
             data.shuffle(epoch)
-        for i in range(data.n_batch_train):
+        for i in range(start_iter, data.n_batch_train):
             recorder.start()
             batch = data.train_batch(i)
             recorder.end("wait")
@@ -283,7 +286,16 @@ def run(
                 _ = float(scores[0])
                 recorder.end("comm")
             recorder.print_train_info(i)
-            _faults.maybe_inject_fault(epoch, i)
+            _faults.maybe_inject_fault(epoch, i,
+                                       checkpoint_dir=checkpoint_dir)
+            _sup.heartbeat(recorder.n_iter, epoch, i,
+                           resumed_from=resumed_from)
+            if _sup.preemption_requested():
+                preempted = True
+                break
+        start_iter = 0
+        if preempted:
+            break
 
         if data.n_batch_val:
             # per-replica validation (reference: each process reports
@@ -308,6 +320,23 @@ def run(
     scores = drain(scores)
     _adopt_best(model, engine, scores)
 
+    if preempted:
+        if checkpoint_dir:
+            recorder.flush()
+            model.save(checkpoint_dir, recorder,
+                       extra_meta={"next_iter": i + 1, "preempted": True})
+        if verbose:
+            print(
+                f"preempted: checkpointed epoch {model.epoch} iter "
+                f"{i + 1}, exiting cleanly", flush=True,
+            )
+        _sup.heartbeat(recorder.n_iter, model.epoch, i,
+                       status="preempted")
+    else:
+        _sup.heartbeat(recorder.n_iter, model.epoch, None,
+                       status="completed")
+    _sup.uninstall_preemption_handler()
+
     last_val = recorder.val_records[-1] if recorder.val_records else {}
     return {
         "epochs": model.epoch,
@@ -318,6 +347,11 @@ def run(
         ),
         "final_val": last_val,
         "epoch_times": recorder.epoch_times,
+        "preempted": preempted,
+        "resumed_from": resumed_from,
+        "restarts": recorder.restart_events,
+        "n_restarts": len(recorder.restart_events),
+        "mttr_s": recorder.mttr_s,
         "recorder": recorder,
         "model": model,
     }
@@ -378,11 +412,12 @@ def _run_distributed(
     recorder = Recorder(
         rank=pid, size=n_procs, print_freq=print_freq, verbose=verbose
     )
-    if resume and checkpoint_dir:
-        # shared filesystem (standard pod setup): everyone restarts
-        # from the adopted-best weights of the previous run
-        if model.load(checkpoint_dir, recorder):
-            model.epoch += 1
+    # shared filesystem (standard pod setup): everyone restarts from
+    # the adopted-best weights of the previous run
+    start_iter, resumed_from = _sup.begin_resilient_run(
+        model, recorder, checkpoint_dir, resume,
+        verbose=verbose and pid == 0,
+    )
 
     # peer bootstrap over the jax.distributed KV store.  The nonce
     # makes repeat run() calls in one distributed session (parameter
@@ -459,12 +494,13 @@ def _run_distributed(
             n_merges += 1
         return score
 
+    preempted = False
     while model.epoch < model.n_epochs:
         epoch = model.epoch
         recorder.start_epoch()
         if hasattr(data, "shuffle"):
             data.shuffle(epoch + pid * 104729)  # decorrelate worker data
-        for i in range(data.n_batch_train):
+        for i in range(start_iter, data.n_batch_train):
             model.train_iter(i, recorder)
             # probe-and-merge whatever the wire delivered (reference:
             # per-iteration MPI probe loop)
@@ -481,7 +517,18 @@ def _run_distributed(
                 n_pushes += 1
             recorder.end("comm")
             recorder.print_train_info(i)
-            _faults.maybe_inject_fault(epoch, i)
+            _faults.maybe_inject_fault(epoch, i,
+                                       checkpoint_dir=checkpoint_dir)
+            _sup.heartbeat(recorder.n_iter, epoch, i,
+                           resumed_from=resumed_from)
+            if _sup.preemption_requested():
+                preempted = True
+                break
+        start_iter = 0
+        if preempted:
+            # fall through to the quiesce path: queued pushes ship,
+            # score mass is conserved, the best scorer checkpoints
+            break
 
         if data.n_batch_val:
             vals = [model.val_iter(j, recorder)
@@ -619,13 +666,29 @@ def _run_distributed(
         # the highest post-drain score saves the final checkpoint
         best = max(final_scores, key=lambda r: final_scores[r])
         if pid == best:
-            model.save(checkpoint_dir, recorder)
+            model.save(
+                checkpoint_dir, recorder,
+                extra_meta=(
+                    {"next_iter": i + 1, "preempted": True}
+                    if preempted else None
+                ),
+            )
     peer.close()
 
+    _sup.heartbeat(
+        recorder.n_iter, model.epoch, None,
+        status="preempted" if preempted else "completed",
+    )
+    _sup.uninstall_preemption_handler()
     last_val = recorder.val_records[-1] if recorder.val_records else {}
     return {
         "epochs": model.epoch,
         "iterations": recorder.n_iter,
+        "preempted": preempted,
+        "resumed_from": resumed_from,
+        "restarts": recorder.restart_events,
+        "n_restarts": len(recorder.restart_events),
+        "mttr_s": recorder.mttr_s,
         "pushes": n_pushes,
         "delivered": sum(delivered.values()),
         "merges": n_merges,
