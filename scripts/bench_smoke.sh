@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Compressed-exchange smoke: the bench.py `compressed` A/B arm at
+# 5 steps x 4 arms (fp32 / int8+EF / fp8+EF / zero1+int8) on the
+# virtual 8-device CPU mesh — a ~2-minute signal that the quantized
+# wire still compiles, runs, traces, and tracks the fp32 loss, for
+# CI and pre-commit use.  The full 50-step protocol is the bench row
+# (TM_BENCH_MODEL=compressed) and the slow-tier tests
+# (tests/test_compression.py --runslow).
+#
+# Usage: bash scripts/bench_smoke.sh
+
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+out=$(TM_COMPRESSED_AB_STEPS=${TM_COMPRESSED_AB_STEPS:-5} \
+      TM_BENCH_MODEL=compressed python bench.py)
+printf '%s\n' "$out" | python -c '
+import json, sys
+row = json.loads(sys.stdin.readline())
+deltas = row.get("loss_delta_vs_fp32", {})
+print("rates      ", row.get("rates"))
+print("loss deltas", deltas)
+print("wire x     ", row.get("wire_reduction"))
+bad = {k: v for k, v in deltas.items() if not v < 0.05}
+if bad:
+    sys.exit("bench_smoke: loss drifted past 5%% of fp32 wire: %s" % bad)
+wr = row.get("wire_reduction", 0)
+if not wr >= 3.5:
+    sys.exit("bench_smoke: wire_reduction below 3.5x: %s" % wr)
+print("bench_smoke: OK")
+'

@@ -16,5 +16,14 @@ python -m compileall -q theanompi_tpu/ || {
     exit 2
 }
 
+# Lint gate: ruff check when installed, python -m pyflakes as the
+# fallback, and the bundled minimal checker (parse + unused module
+# imports) when the image has neither — the gate never silently
+# no-ops.  See scripts/lint_gate.py.
+python scripts/lint_gate.py || {
+    echo "tier1: lint gate failed (findings above)" >&2
+    exit 2
+}
+
 # --- ROADMAP.md tier-1 verify, verbatim ---
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

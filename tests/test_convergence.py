@@ -16,7 +16,6 @@ trivially; comparing them at a non-trivial plateau is what makes the
 1-vs-8 equivalence assertion discriminative.
 """
 
-import numpy as np
 import pytest
 
 BASE = {

@@ -1007,3 +1007,140 @@ class TestConsistencyCheck:
         )
         delta = jax.jit(fn)(stacked)
         assert float(np.max(np.asarray(delta))) > 0.1
+
+
+class TestWireDtypeEdges:
+    """wire_dtype edge cases in flat_pack/scatter_update_gather
+    (ISSUE 4 satellite): integer leaves, zero-size leaves under cast,
+    and the bitwise fp32-wire == no-wire-dtype identity."""
+
+    def _sug(self, mesh8, params, grads_stacked, wire_dtype):
+        spec = flat_spec(params, 8)
+
+        def body(p, g):
+            local_p = jax.tree.map(lambda x: x[0], p)
+            local_g = jax.tree.map(lambda x: x[0], g)
+
+            def upd(ps, gs):
+                return (ps - 0.1 * gs).astype(ps.dtype), ()
+
+            np_, _ = scatter_update_gather(
+                local_p, local_g, upd, DATA_AXIS,
+                wire_dtype=wire_dtype, spec=spec,
+            )
+            return jax.tree.map(lambda x: x[None], np_)
+
+        fn = shard_map(
+            body, mesh=mesh8,
+            in_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+            out_specs=P(DATA_AXIS),
+            check_vma=False,
+        )
+        stacked_p = jax.tree.map(
+            lambda x: jnp.stack([x] * 8), params
+        )
+        return jax.jit(fn)(stacked_p, grads_stacked)
+
+    def test_fp32_wire_bitwise_equals_no_wire(self, mesh8, rng):
+        """wire_dtype=jnp.float32 must be the IDENTITY cast: bitwise
+        the same collective as wire_dtype=None, in both allreduce_mean
+        and scatter_update_gather."""
+        stacked, _ = _per_device_trees(rng)
+
+        def mean(wire):
+            fn = shard_map(
+                lambda t: jax.tree.map(
+                    lambda x: x[None],
+                    allreduce_mean(
+                        jax.tree.map(lambda x: x[0], t), DATA_AXIS,
+                        wire_dtype=wire,
+                    ),
+                ),
+                mesh=mesh8, in_specs=P(DATA_AXIS),
+                out_specs=P(DATA_AXIS),
+            )
+            return jax.jit(fn)(stacked)
+
+        a, b = mean(None), mean(jnp.float32)
+        for k in ("w", "b"):
+            np.testing.assert_array_equal(np.asarray(a[k]),
+                                          np.asarray(b[k]))
+
+        params = _tree(rng)
+        p_none = self._sug(mesh8, params, stacked, None)
+        p_f32 = self._sug(mesh8, params, stacked, jnp.float32)
+        for k in ("w", "b"):
+            np.testing.assert_array_equal(np.asarray(p_none[k]),
+                                          np.asarray(p_f32[k]))
+
+    def test_integer_leaves_under_wire_cast(self, mesh8, rng):
+        """An int32 leaf rides the fp32 master buffer through the cast
+        wire and restores its dtype and (identity-update) values
+        exactly — int magnitudes small enough for bf16 to hold."""
+        params = {
+            "w": jnp.asarray(rng.normal(size=(6,)), jnp.float32),
+            "step": jnp.arange(4, dtype=jnp.int32),
+        }
+        grads = {
+            "w": jnp.asarray(rng.normal(size=(6,)), jnp.float32),
+            "step": jnp.zeros((4,), jnp.int32),
+        }
+        stacked_g = jax.tree.map(lambda x: jnp.stack([x] * 8), grads)
+        spec = flat_spec(params, 8)
+        assert spec.dtype == jnp.float32
+
+        def body(p, g):
+            local_p = jax.tree.map(lambda x: x[0], p)
+            local_g = jax.tree.map(lambda x: x[0], g)
+
+            def upd(ps, gs):
+                return ps, ()          # identity: dtype round-trip only
+
+            np_, _ = scatter_update_gather(
+                local_p, local_g, upd, DATA_AXIS,
+                wire_dtype=jnp.bfloat16, spec=spec,
+            )
+            return jax.tree.map(lambda x: x[None], np_)
+
+        fn = shard_map(
+            body, mesh=mesh8,
+            in_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+            out_specs=P(DATA_AXIS), check_vma=False,
+        )
+        stacked_p = jax.tree.map(lambda x: jnp.stack([x] * 8), params)
+        out = jax.jit(fn)(stacked_p, stacked_g)
+        assert out["step"].dtype == jnp.int32
+        np.testing.assert_array_equal(np.asarray(out["step"][0]),
+                                      np.arange(4, dtype=np.int32))
+        np.testing.assert_array_equal(np.asarray(out["w"][0]),
+                                      np.asarray(params["w"]))
+
+    def test_zero_size_leaf_under_wire_cast(self, mesh8, rng):
+        """A (0,)-shaped leaf must survive the bf16 wire cast in both
+        exchange shapes (the cast maps over every leaf — an empty one
+        must not break pack/concat/collective lowering)."""
+        tree = {
+            "w": jnp.asarray(rng.normal(size=(4, 2)), jnp.float32),
+            "empty": jnp.zeros((0,), jnp.float32),
+        }
+        stacked = jax.tree.map(lambda x: jnp.stack([x] * 8), tree)
+
+        fn = shard_map(
+            lambda t: jax.tree.map(
+                lambda x: x[None],
+                allreduce_mean(
+                    jax.tree.map(lambda x: x[0], t), DATA_AXIS,
+                    wire_dtype=jnp.bfloat16,
+                ),
+            ),
+            mesh=mesh8, in_specs=P(DATA_AXIS), out_specs=P(DATA_AXIS),
+        )
+        out = jax.jit(fn)(stacked)
+        assert out["empty"].shape == (8, 0)
+        np.testing.assert_allclose(
+            np.asarray(out["w"][0]), np.asarray(tree["w"]),
+            rtol=1e-2,
+        )
+
+        p2 = self._sug(mesh8, tree, stacked, jnp.bfloat16)
+        assert p2["empty"].shape == (8, 0)

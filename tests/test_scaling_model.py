@@ -10,7 +10,6 @@ import pytest
 
 from theanompi_tpu.models.llama import LLAMA3_8B
 from theanompi_tpu.utils.scaling_model import (
-    V5E,
     allreduce_time,
     bsp_efficiency,
     ici_links_used,
@@ -305,3 +304,60 @@ def test_llama8b_dress_rehearsal_tp4_pp4(devices16, tmp_path):
         jax.tree.leaves(model.params), jax.tree.leaves(m2.params)
     ):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_exchange_wire_bytes_compression_factor():
+    """int8/fp8 wire ships ~4x fewer bytes than fp32 for MB-scale
+    packs (ISSUE 4 acceptance: >= 3.5x in the accounting) — the
+    per-chunk scale overhead only matters for pathological tiny
+    buckets."""
+    from theanompi_tpu.utils.scaling_model import exchange_wire_bytes
+
+    pb = 100 * 2**20                       # 100 MB fp32 grads
+    fp32 = exchange_wire_bytes(pb, wire="fp32", n_shards=64)
+    bf16 = exchange_wire_bytes(pb, wire="bf16", n_shards=64)
+    int8 = exchange_wire_bytes(pb, wire="int8", n_shards=64)
+    fp8 = exchange_wire_bytes(pb, wire="fp8", n_shards=64)
+    assert fp32 == pb
+    assert bf16 == pb / 2
+    assert fp32 / int8 >= 3.5
+    assert fp32 / fp8 >= 3.5
+    # tiny buckets: scale overhead grows (one f32 per bucket x shard)
+    tiny = exchange_wire_bytes(pb, wire="int8", n_shards=64,
+                               bucket_bytes=2**12)
+    assert tiny > int8
+
+
+def test_compression_table_dcn_win():
+    """Over DCN at 16-64 chips the fp32 wire's exposed time dominates
+    (the ISSUE's motivation); the int8 table must show wire_reduction
+    >= 3.5 and efficiency strictly better wherever the baseline is
+    exposed."""
+    from theanompi_tpu.utils.scaling_model import compression_table
+
+    rows = compression_table(
+        step_time_1chip=0.110,
+        param_bytes=250e6 * 4,             # flagship-proxy-scale pack
+        wire="int8", transport="dcn",
+    )
+    assert [r["n_chips"] for r in rows] == [8, 16, 64]
+    for r in rows:
+        assert r["wire_reduction"] >= 3.5
+        assert r["efficiency"] <= 1.0
+        assert r["efficiency"] >= r["efficiency_baseline"]
+        assert r["speedup"] >= 1.0
+    # the baseline must actually be exposed over DCN at this scale —
+    # otherwise the table proves nothing
+    assert rows[-1]["t_exposed_baseline_ms"] > 0
+    assert rows[-1]["speedup"] > 1.5
+
+
+def test_bsp_efficiency_compression_kwarg():
+    from theanompi_tpu.utils.scaling_model import bsp_efficiency
+
+    base = dict(step_time_1chip=0.1, param_bytes=100 * 2**20,
+                n_chips=64)
+    fp32 = bsp_efficiency(**base)
+    int8 = bsp_efficiency(**base, compression="int8")
+    assert int8["wire_mb"] < fp32["wire_mb"] / 3.5
+    assert int8["efficiency_overlap"] >= fp32["efficiency_overlap"]

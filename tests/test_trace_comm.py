@@ -245,3 +245,62 @@ class TestRealCollectives:
         rep = comm_report(str(tmp_path))
         assert rep["n_cores"] >= 2, rep
         assert rep["collective_s"] > 0.0, rep
+
+
+class TestQuantAttribution:
+    def test_scope_op_names_extracts_marked_instructions(self):
+        from theanompi_tpu.utils.trace_comm import scope_op_names
+
+        hlo = '''
+HloModule jit_step
+%fused_q {
+  ROOT %multiply.4 = f32[8]{0} multiply(...), metadata={op_name="jit(step)/quantize_wire/div" source_file="x.py"}
+}
+ENTRY %main {
+  %convert_slice_fusion.2 = s8[8]{0} fusion(...), kind=kLoop, calls=%fused_q, metadata={op_name="jit(step)/quantize_wire/convert_element_type"}
+  %broadcast_multiply_fusion = f32[8]{0} fusion(...), metadata={op_name="jit(step)/dequantize_wire/mul"}
+  %dot.7 = f32[8,8]{1,0} dot(...), metadata={op_name="jit(step)/matmul"}
+  %all-to-all.4 = s8[8]{0} all-to-all(...), metadata={op_name="jit(step)/all_to_all"}
+}
+'''
+        names = scope_op_names(hlo)
+        assert "convert_slice_fusion.2" in names
+        assert "broadcast_multiply_fusion" in names
+        assert "multiply.4" in names        # fused-computation root
+        assert "dot.7" not in names
+        assert "all-to-all.4" not in names
+
+    def test_comm_report_sums_quant_ops(self, tmp_path):
+        """quant ops count as compute for the hidden/exposed split AND
+        sum into quant_s."""
+        from theanompi_tpu.utils.trace_comm import comm_report
+
+        # one core: 100ps collective, then 50ps quantize, 150ps dot
+        # (events are (name, start_ps, duration_ps))
+        _write_trace(tmp_path, [[
+            ("all-reduce.1", 0, 100),
+            ("quant_fusion.1", 100, 50),
+            ("dot.1", 150, 150),
+        ]])
+        rep = comm_report(str(tmp_path), quant_ops={"quant_fusion.1"})
+        assert rep["quant_s"] == pytest.approx(50e-12)
+        assert rep["quant_frac"] == pytest.approx(50.0 / 300.0)
+        # quant time is compute: it does NOT join the collective set
+        assert rep["collective_s"] == pytest.approx(100e-12)
+        # and without the op set the field is zero, not absent
+        rep0 = comm_report(str(tmp_path))
+        assert rep0["quant_s"] == 0.0
+
+    def test_tfrt_cpu_lanes_recognized(self):
+        """The XLA:CPU thunk lanes on this image are named
+        tf_XLATfrtCpuClient/... — their absence from the lane filter
+        was why CPU-mesh traces reported zero cores (the BENCH_r05
+        null exposed_comm_frac)."""
+        from theanompi_tpu.utils.trace_comm import CPU_LANE_PREFIXES
+
+        for lane in (
+            "tf_XLATfrtCpuClient/-2001582753",
+            "tf_XLAPjRtCpuClient/123",
+            "tf_XLAEigen/7",
+        ):
+            assert lane.lower().startswith(CPU_LANE_PREFIXES), lane

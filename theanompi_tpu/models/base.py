@@ -20,7 +20,6 @@ from __future__ import annotations
 
 import math
 import time
-from functools import partial
 from typing import Any, Optional
 
 import jax
@@ -34,6 +33,7 @@ from theanompi_tpu.ops.layers import accuracy, softmax_cross_entropy
 from theanompi_tpu.parallel import (
     DATA_AXIS,
     allreduce_mean,
+    compressed_allreduce_mean,
     flat_spec,
     get_strategy,
     make_mesh,
@@ -65,6 +65,9 @@ class TMModel:
     data: Any
     epoch: int
     n_epochs: int
+    #: EF residual of a compressed exchange (empty when off); models
+    #: that compile one overwrite this with device state
+    ef_state: PyTree = {}
 
     def build_model(self, n_replicas: int = 1) -> None:
         raise NotImplementedError
@@ -188,6 +191,14 @@ class TMModel:
         z_layout = getattr(self, "_zero1_layout", None)
         if z_layout is not None:
             meta["zero1_layout"] = list(z_layout)
+        # the error-feedback residual of a compressed exchange is part
+        # of worker state: a resume that silently dropped (or
+        # re-zeroed) it would break the interrupted==uninterrupted
+        # bitwise guarantee, so its layout is stamped like the zero1
+        # bucket layout and checked on load
+        ef_layout = getattr(self, "_ef_layout", None)
+        if ef_layout is not None:
+            meta["ef_layout"] = list(ef_layout)
         trees = self.checkpoint_trees()
         if self._checkpoint_format(trees) == "sharded":
             save_sharded_checkpoint(
@@ -209,12 +220,28 @@ class TMModel:
         path = latest_checkpoint(directory, validate=validate)
         if path is None:
             return False
-        if is_sharded_checkpoint(path):
-            trees, meta = load_sharded_checkpoint(
-                path, self.checkpoint_trees()
-            )
-        else:
-            trees, meta = load_checkpoint(path, self.checkpoint_trees())
+        like = self.checkpoint_trees()
+        try:
+            if is_sharded_checkpoint(path):
+                trees, meta = load_sharded_checkpoint(path, like)
+            else:
+                trees, meta = load_checkpoint(path, like)
+        except KeyError as e:
+            # only translate when the MISSING leaf is the residual's
+            # (both loaders name the group in the error) — any other
+            # group's mismatch keeps its own diagnostic
+            if "ef_state" in like and "ef_state" in str(e):
+                raise ValueError(
+                    f"checkpoint {path} lacks the error-feedback "
+                    f"residual group ('ef_state') this model's "
+                    f"compressed exchange carries — resuming would "
+                    f"silently drop the EF residual and break the "
+                    f"interrupted==uninterrupted guarantee; resume "
+                    f"from a checkpoint written with the same "
+                    f"exch_compression, or set "
+                    f"exch_compression='none'"
+                ) from e
+            raise
         # bucket-layout guard BEFORE any state is attached: when this
         # model already compiled a zero1 step, the restored flat
         # optimizer shard is only meaningful under the layout it was
@@ -235,6 +262,35 @@ class TMModel:
                     f"exchange_bucket_mb to the value the checkpoint "
                     f"was trained with"
                 )
+        # EF-layout guard, same shape as the zero1 one: the residual's
+        # flat order is (compression, padded, bucket_len)-dependent,
+        # so a mismatched resume must refuse instead of re-injecting
+        # rows against the wrong parameters
+        cur_ef = getattr(self, "_ef_layout", None)
+        if cur_ef is not None and "ef_state" in trees:
+            saved_ef = meta.get("ef_layout")
+            if saved_ef is None or tuple(saved_ef) != tuple(cur_ef):
+                raise ValueError(
+                    f"checkpoint EF-residual layout "
+                    f"{saved_ef and tuple(saved_ef)} (compression, "
+                    f"padded, bucket_len) does not match the compiled "
+                    f"exchange layout {tuple(cur_ef)} — set "
+                    f"exch_compression/exchange_bucket_mb to the "
+                    f"values the checkpoint was trained with"
+                )
+        self._restored_ef_layout = meta.get("ef_layout")
+        self._restored_ef = "ef_state" in trees
+        # the checkpoint carries an EF residual (its layout is
+        # stamped) that this load did NOT attach — the model hasn't
+        # compiled its compressed exchange yet, so checkpoint_trees()
+        # had no ef_state slot.  Remember it: a later
+        # compile_iter_fns(exch_compression=...) must refuse instead
+        # of silently installing fresh zero residuals (compile-then-
+        # load is the supported order, as for zero1 state).
+        self._restored_ef_orphaned = (
+            meta.get("ef_layout") is not None
+            and "ef_state" not in trees
+        )
         self._restored_zero1_layout = meta.get("zero1_layout")
         # workers read this for resilience metadata the load() bool
         # can't carry: next_iter (mid-epoch preemption checkpoints),
@@ -290,6 +346,7 @@ class ClassifierModel(TMModel):
         self.params: PyTree = None
         self.net_state: PyTree = None
         self.opt_state: PyTree = None
+        self.ef_state: PyTree = {}
         self.mesh: Optional[Mesh] = None
         self._train_step = None
         self._val_step = None
@@ -337,17 +394,27 @@ class ClassifierModel(TMModel):
         # fixed buckets whose collectives pipeline against compute;
         # 0 keeps the monolithic exchange.  Default ~4 MiB — tiny
         # models degrade to monolithic inside flat_spec.
-        from theanompi_tpu.parallel import resolve_bucket_mb
+        from theanompi_tpu.parallel import (
+            resolve_bucket_mb,
+            resolve_compression,
+        )
         from theanompi_tpu.parallel.exchange import flat_layout
 
         bucket_elems = strat.bucket_elems(resolve_bucket_mb(self.config))
         self._bucket_elems = bucket_elems
+        # exch_compression: int8/fp8 quantized wire for the gradient
+        # exchange (per-bucket symmetric scales), with an
+        # error-feedback residual in worker state re-injecting the
+        # quantization error next step (parallel/exchange)
+        comp, use_ef = resolve_compression(self.config)
+        self._compression, self._error_feedback = comp, use_ef
 
         n_dp = self.mesh.shape[DATA_AXIS]
-        zspec = (
+        fspec = (
             flat_spec(self.params, n_dp, bucket_elems=bucket_elems)
-            if strat.zero1 else None
+            if (strat.zero1 or comp) else None
         )
+        zspec = fspec if strat.zero1 else None
         # the layout the knob ACTUALLY produced (tiny models degrade
         # to monolithic inside flat_layout) — gates the overlap
         # preset and stamps zero1 checkpoints (a resumed bucket-major
@@ -407,6 +474,58 @@ class ClassifierModel(TMModel):
         self._opt_specs = opt_spec
         self._zero1 = strat.zero1
 
+        # EF residual state: r1 is each device's own [padded] residual
+        # of the local-grad compression (global [n_dp*padded] sharded
+        # over data); r2 (non-zero1 only) the shard-owner residual of
+        # the reduced-mean compression ([shard_len] per device —
+        # zero1's param gather is uncompressed, so it has no phase-2
+        # residual).  error_feedback=False runs plain QSGD: no state.
+        ef_proto = {}
+        if comp and use_ef:
+            ef_proto["r1"] = jnp.zeros(
+                (n_dp * fspec.padded,), jnp.float32
+            )
+            if not strat.zero1:
+                ef_proto["r2"] = jnp.zeros((fspec.padded,), jnp.float32)
+        self._ef_layout = (
+            (comp, fspec.padded, fspec.bucket_len)
+            if comp and use_ef else None
+        )
+        if ef_proto and getattr(self, "_restored_ef_orphaned", False):
+            raise ValueError(
+                "a checkpoint restored BEFORE this compile carried an "
+                "EF residual (ef_layout stamped) that load() could "
+                "not attach — the model had no compressed exchange "
+                "yet.  Compiling now would silently zero the "
+                "residual; compile_iter_fns first, then load()"
+            )
+        if ef_proto and getattr(self, "_restored_ef", False):
+            saved = getattr(self, "_restored_ef_layout", None)
+            ok = (
+                isinstance(self.ef_state, dict)
+                and set(self.ef_state) == set(ef_proto)
+                and all(
+                    tuple(jnp.shape(self.ef_state[k]))
+                    == tuple(jnp.shape(v))
+                    for k, v in ef_proto.items()
+                )
+                and saved is not None
+                and tuple(saved) == self._ef_layout
+            )
+            if not ok:
+                raise ValueError(
+                    "compile_iter_fns with exch_compression after a "
+                    "checkpoint restore found an EF residual that "
+                    "does not match the compiled exchange layout "
+                    "(compression, padded, bucket_len) — compile "
+                    "first, then load(); cross-layout resume is not "
+                    "supported"
+                )
+        else:
+            self.ef_state = ef_proto
+        ef_spec = jax.tree.map(lambda _: P(DATA_AXIS), ef_proto)
+        self._ef_specs = ef_spec
+
         def loss_fn(params, net_state, x, y, rng):
             out, new_state = net.apply(
                 params, net_state, self.prep_input(x), train=True, rng=rng
@@ -415,7 +534,7 @@ class ClassifierModel(TMModel):
             err = 1.0 - accuracy(self.primary_logits(out), y)
             return loss, (new_state, err)
 
-        def shard_train(params, net_state, opt_state, x, y, lr, rng):
+        def shard_train(params, net_state, opt_state, ef, x, y, lr, rng):
             rng = jax.random.fold_in(rng, lax.axis_index(DATA_AXIS))
             grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
             (loss, (new_state, err)), grads = grad_fn(
@@ -437,23 +556,48 @@ class ClassifierModel(TMModel):
                 # allreduce, optimizer HBM /N).  With buckets the
                 # three phases pipeline per bucket (state sliced by
                 # scatter_update_gather — hence the 3-arg closure).
+                # With exch_compression the grad reduce-scatter ships
+                # 1-byte chunks + per-chunk scales; the param gather
+                # stays master-width (quantized params would corrupt
+                # the replicated masters).
                 def opt_upd(p_shard, g_shard, state):
                     return optimizer.update(p_shard, g_shard, state, lr)
 
-                params, opt_state = scatter_update_gather(
-                    params, grads, opt_upd, DATA_AXIS,
-                    wire_dtype=strat.wire_dtype, spec=zspec,
-                    opt_state=opt_state,
-                )
+                if comp:
+                    params, opt_state, r1n = scatter_update_gather(
+                        params, grads, opt_upd, DATA_AXIS,
+                        spec=zspec, opt_state=opt_state,
+                        compression=comp, r1=ef.get("r1"),
+                    )
+                    if "r1" in ef:
+                        ef = {"r1": r1n}
+                else:
+                    params, opt_state = scatter_update_gather(
+                        params, grads, opt_upd, DATA_AXIS,
+                        wire_dtype=strat.wire_dtype, spec=zspec,
+                        opt_state=opt_state,
+                    )
             else:
                 # THE exchange: BSP allreduce folded into the step
                 # (reference: BSP_Exchanger.exchange between train
-                # iters), bucketed when exchange_bucket_mb says so.
-                grads = strat(grads, DATA_AXIS, bucket_elems)
+                # iters), bucketed when exchange_bucket_mb says so;
+                # exch_compression swaps it for the quantized
+                # two-phase wire with the EF residual threaded through
+                # worker state.
+                if comp:
+                    grads, r1n, r2n = compressed_allreduce_mean(
+                        grads, DATA_AXIS, compression=comp,
+                        r1=ef.get("r1"), r2=ef.get("r2"),
+                        bucket_elems=bucket_elems,
+                    )
+                    if "r1" in ef:
+                        ef = {"r1": r1n, "r2": r2n}
+                else:
+                    grads = strat(grads, DATA_AXIS, bucket_elems)
                 params, opt_state = optimizer.update(
                     params, grads, opt_state, lr
                 )
-            return params, new_state, opt_state, loss, err
+            return params, new_state, opt_state, ef, loss, err
 
         def shard_val(params, net_state, x, y):
             out, _ = net.apply(
@@ -482,11 +626,11 @@ class ClassifierModel(TMModel):
             jax.shard_map(
                 shard_train,
                 mesh=self.mesh,
-                in_specs=(rep, rep, opt_spec, dp, dp, rep, rep),
-                out_specs=(rep, rep, opt_spec, rep, rep),
+                in_specs=(rep, rep, opt_spec, ef_spec, dp, dp, rep, rep),
+                out_specs=(rep, rep, opt_spec, ef_spec, rep, rep),
                 check_vma=False,
             ),
-            donate_argnums=(0, 1, 2),
+            donate_argnums=(0, 1, 2, 3),
             compiler_options=self._compiler_options,
         )
 
@@ -519,6 +663,10 @@ class ClassifierModel(TMModel):
             opt_spec if strat.zero1 else jax.tree.map(
                 lambda _: P(), self.opt_state
             ),
+        )
+        self.ef_state = jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
+            self.ef_state, ef_spec,
         )
         self._data_sharding = NamedSharding(self.mesh, P(DATA_AXIS))
 
@@ -597,7 +745,7 @@ class ClassifierModel(TMModel):
         b_local = gb // n_shards
         body = self._shard_train_body
 
-        def shard_cached(params, net_state, opt_state, step,
+        def shard_cached(params, net_state, opt_state, ef, step,
                          xs, ys, perm, lr, key0):
             nb = perm.shape[0] // gb
             i = (step % nb).astype(jnp.int32)
@@ -605,23 +753,26 @@ class ClassifierModel(TMModel):
             start = i * gb + me * b_local
             idx = lax.dynamic_slice(perm, (start,), (b_local,))
             rng = jax.random.fold_in(key0, step)
-            p, s, o, loss, err = body(
-                params, net_state, opt_state, xs[idx], ys[idx], lr, rng
+            p, s, o, ef, loss, err = body(
+                params, net_state, opt_state, ef, xs[idx], ys[idx],
+                lr, rng
             )
-            return p, s, o, step + 1, loss, err
+            return p, s, o, ef, step + 1, loss, err
 
         rep_s, dp = P(), P(DATA_AXIS)
         osp = self._opt_specs  # zero1: data-sharded flat opt buffers
+        efsp = self._ef_specs  # compressed: data-sharded EF residuals
         self._train_step_cached = jax.jit(
             jax.shard_map(
                 shard_cached,
                 mesh=self.mesh,
-                in_specs=(rep_s, rep_s, osp, rep_s, rep_s, rep_s,
-                          rep_s, rep_s, rep_s),
-                out_specs=(rep_s, rep_s, osp, rep_s, rep_s, rep_s),
+                in_specs=(rep_s, rep_s, osp, efsp, rep_s, rep_s,
+                          rep_s, rep_s, rep_s, rep_s),
+                out_specs=(rep_s, rep_s, osp, efsp, rep_s, rep_s,
+                           rep_s),
                 check_vma=False,
             ),
-            donate_argnums=(0, 1, 2, 3),
+            donate_argnums=(0, 1, 2, 3, 4),
             compiler_options=self._compiler_options,
         )
 
@@ -635,30 +786,31 @@ class ClassifierModel(TMModel):
         self._train_scan = None
         k = int(self.config.get("steps_per_call", 0) or 0)
         if k > 1:
-            def shard_cached_scan(params, net_state, opt_state, step,
-                                  xs, ys, perm, lr, key0):
+            def shard_cached_scan(params, net_state, opt_state, ef,
+                                  step, xs, ys, perm, lr, key0):
                 def scan_body(carry, _):
-                    p, s, o, st = carry
-                    p, s, o, st, loss, err = shard_cached(
-                        p, s, o, st, xs, ys, perm, lr, key0
+                    p, s, o, e, st = carry
+                    p, s, o, e, st, loss, err = shard_cached(
+                        p, s, o, e, st, xs, ys, perm, lr, key0
                     )
-                    return (p, s, o, st), (loss, err)
+                    return (p, s, o, e, st), (loss, err)
 
-                (p, s, o, st), (losses, errs) = lax.scan(
-                    scan_body, (params, net_state, opt_state, step),
+                (p, s, o, e, st), (losses, errs) = lax.scan(
+                    scan_body,
+                    (params, net_state, opt_state, ef, step),
                     None, length=k,
                 )
-                return p, s, o, st, losses, errs
+                return p, s, o, e, st, losses, errs
 
             self._train_scan = jax.jit(
                 jax.shard_map(
                     shard_cached_scan,
                     mesh=self.mesh,
-                    in_specs=(rep_s, rep_s, osp) + (rep_s,) * 6,
-                    out_specs=(rep_s, rep_s, osp) + (rep_s,) * 3,
+                    in_specs=(rep_s, rep_s, osp, efsp) + (rep_s,) * 6,
+                    out_specs=(rep_s, rep_s, osp, efsp) + (rep_s,) * 3,
                     check_vma=False,
                 ),
-                donate_argnums=(0, 1, 2, 3),
+                donate_argnums=(0, 1, 2, 3, 4),
                 compiler_options=self._compiler_options,
             )
             self._scan_k = k
@@ -695,14 +847,15 @@ class ClassifierModel(TMModel):
         if self._train_step_cached is not None and self._perm_dev is not None:
             lowered = self._train_step_cached.lower(
                 self.params, self.net_state, self.opt_state,
-                self._step_dev, self._device_cache[0],
+                self.ef_state, self._step_dev, self._device_cache[0],
                 self._device_cache[1], self._perm_dev, self._lr_dev,
                 self._key0_dev,
             )
         else:
             x, y = self.put_batch(self.data.train_batch(0))
             lowered = self._train_step.lower(
-                self.params, self.net_state, self.opt_state, x, y,
+                self.params, self.net_state, self.opt_state,
+                self.ef_state, x, y,
                 jnp.float32(self.current_lr), self._rng,
             )
         return lowered.compile().cost_analysis()
@@ -724,6 +877,7 @@ class ClassifierModel(TMModel):
             self.params,
             self.net_state,
             self.opt_state,
+            self.ef_state,
             self._step_dev,
             losses,
             errs,
@@ -731,6 +885,7 @@ class ClassifierModel(TMModel):
             self.params,
             self.net_state,
             self.opt_state,
+            self.ef_state,
             self._step_dev,
             self._device_cache[0],
             self._device_cache[1],
@@ -756,6 +911,7 @@ class ClassifierModel(TMModel):
                 self.params,
                 self.net_state,
                 self.opt_state,
+                self.ef_state,
                 self._step_dev,
                 loss,
                 err,
@@ -763,6 +919,7 @@ class ClassifierModel(TMModel):
                 self.params,
                 self.net_state,
                 self.opt_state,
+                self.ef_state,
                 self._step_dev,
                 self._device_cache[0],
                 self._device_cache[1],
@@ -784,12 +941,14 @@ class ClassifierModel(TMModel):
             self.params,
             self.net_state,
             self.opt_state,
+            self.ef_state,
             loss,
             err,
         ) = self._train_step(
             self.params,
             self.net_state,
             self.opt_state,
+            self.ef_state,
             x,
             y,
             jnp.float32(self.current_lr),
@@ -817,11 +976,17 @@ class ClassifierModel(TMModel):
     # -- checkpoint / resume (reference: helper_funcs save/load) ----------
 
     def checkpoint_trees(self) -> dict[str, PyTree]:
-        return {
+        trees = {
             "params": self.params,
             "net_state": self.net_state,
             "opt_state": self.opt_state,
         }
+        # the EF residual is worker state (compressed exchange): a
+        # resume without it would re-inject nothing and diverge from
+        # the uninterrupted run
+        if getattr(self, "ef_state", None):
+            trees["ef_state"] = self.ef_state
+        return trees
 
     def _place_restored(self) -> None:
         if self.mesh is None:
@@ -840,3 +1005,10 @@ class ClassifierModel(TMModel):
             lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
             self.opt_state, osp,
         )
+        if getattr(self, "ef_state", None):
+            self.ef_state = jax.tree.map(
+                lambda x, s: jax.device_put(
+                    x, NamedSharding(self.mesh, s)
+                ),
+                self.ef_state, getattr(self, "_ef_specs", {}),
+            )

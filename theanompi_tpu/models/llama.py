@@ -68,6 +68,7 @@ from theanompi_tpu.parallel import (
     MODEL_AXIS,
     PIPE_AXIS,
     SEQ_AXIS,
+    compressed_allreduce_mean,
     get_strategy,
     last_stage_value,
     make_mesh,
@@ -611,10 +612,17 @@ class Llama(TMModel):
         # 0 = monolithic): per-bucket collectives pipeline against
         # compute — see parallel/exchange.  Small models degrade to
         # the monolithic path inside flat_spec.
-        from theanompi_tpu.parallel import resolve_bucket_mb
+        from theanompi_tpu.parallel import (
+            resolve_bucket_mb,
+            resolve_compression,
+        )
 
         bucket_elems = strat.bucket_elems(resolve_bucket_mb(self.config))
         self._bucket_elems = bucket_elems
+        # exch_compression: int8/fp8 quantized DP gradient wire with
+        # error-feedback residuals in worker state (parallel/exchange)
+        comp, use_ef = resolve_compression(self.config)
+        self._compression, self._error_feedback = comp, use_ef
         if mesh is None:
             mesh = make_mesh(
                 model=self.tp, seq=self.sp, pipe=self.pp, expert=self.ep
@@ -736,6 +744,46 @@ class Llama(TMModel):
             )
         self._specs, self._opt_specs = specs, opt_specs
         self._zero1 = zero1
+
+        # EF residuals of the compressed exchange: flat per-device
+        # buffers (r1 [z_padded] — local-grad compression; r2
+        # [z_padded/n_dp], non-zero1 only — reduced-mean compression),
+        # varying over every non-seq mesh axis like the zero1 state
+        # (the packed local grads differ across tp/pp shards AND data
+        # replicas; they are seq-invariant — param grads are psum'd
+        # over seq inside autodiff).
+        if comp and self.n_experts:
+            raise NotImplementedError(
+                "exch_compression does not yet compose with MoE "
+                "expert sharding (n_experts > 0): expert and dense "
+                "leaves exchange over different shard groups, so "
+                "there is no single flat buffer to quantize (same "
+                "split that keeps MoE+zero1 NotImplementedError)"
+            )
+        ef_axes = tuple(
+            a for a in (PIPE_AXIS, EXPERT_AXIS, DATA_AXIS, MODEL_AXIS)
+            if a in mesh.shape
+        )
+        ef_proto, ef_specs = {}, {}
+        if comp and use_ef:
+            mult = 1
+            for a in ef_axes:
+                mult *= mesh.shape[a]
+            ef_proto["r1"] = jax.ShapeDtypeStruct(
+                (z_padded * mult,), jnp.float32
+            )
+            if not zero1:
+                ef_proto["r2"] = jax.ShapeDtypeStruct(
+                    (z_padded // n_dp * mult,), jnp.float32
+                )
+            ef_specs = jax.tree.map(
+                lambda _: P(ef_axes), ef_proto,
+                is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct),
+            )
+        self._ef_layout = (
+            (comp, z_padded, z_bucket_len) if comp and use_ef else None
+        )
+        self._ef_specs = ef_specs
         batch_spec = P(
             dp_axes if len(dp_axes) > 1 else dp_axes[0], SEQ_AXIS
         )
@@ -780,7 +828,7 @@ class Llama(TMModel):
         dp_spec = dp_axes if len(dp_axes) > 1 else dp_axes[0]
         ep = self.ep
 
-        def step(params, opt_state, x, y, lr):
+        def step(params, opt_state, ef, x, y, lr):
             # Pre-cast params to DP-VARYING before autodiff: if they
             # stayed invariant, the vma transpose of their broadcast
             # into the data-varying compute would insert an implicit
@@ -878,26 +926,48 @@ class Llama(TMModel):
                 # replicated fp32 m/v never exist.  With buckets the
                 # exchange pipelines per bucket (opt_state sliced
                 # inside scatter_update_gather — 3-arg closure).
+                # exch_compression quantizes the grad reduce-scatter
+                # (1-byte chunks + scales; param gather stays master
+                # width) with the EF residual threaded through ef.
                 def opt_upd(p_shard, g_shard, state):
                     return optimizer.update(
                         p_shard, g_shard, state, lr
                     )
 
-                params, new_opt = scatter_update_gather(
-                    params, grads, opt_upd, dp_spec,
-                    wire_dtype=strat.wire_dtype,
-                    opt_state=opt_state,
-                    bucket_elems=bucket_elems,
-                )
+                if comp:
+                    params, new_opt, r1n = scatter_update_gather(
+                        params, grads, opt_upd, dp_spec,
+                        opt_state=opt_state,
+                        bucket_elems=bucket_elems,
+                        compression=comp, r1=ef.get("r1"),
+                    )
+                    if "r1" in ef:
+                        ef = {"r1": r1n}
+                else:
+                    params, new_opt = scatter_update_gather(
+                        params, grads, opt_upd, dp_spec,
+                        wire_dtype=strat.wire_dtype,
+                        opt_state=opt_state,
+                        bucket_elems=bucket_elems,
+                    )
                 opt_state = new_opt
             else:
-                grads = strat(grads, dp_spec, bucket_elems)
+                if comp:
+                    grads, r1n, r2n = compressed_allreduce_mean(
+                        grads, dp_spec, compression=comp,
+                        r1=ef.get("r1"), r2=ef.get("r2"),
+                        bucket_elems=bucket_elems,
+                    )
+                    if "r1" in ef:
+                        ef = {"r1": r1n, "r2": r2n}
+                else:
+                    grads = strat(grads, dp_spec, bucket_elems)
                 params, opt_state = optimizer.update(
                     params, grads, opt_state, lr
                 )
             loss = lax.pmean(loss, dp_axes)
             err = lax.pmean(err, dp_axes)
-            return params, opt_state, loss, err
+            return params, opt_state, ef, loss, err
 
         def val(params, x, y):
             logits = self._forward(params, x)
@@ -921,10 +991,11 @@ class Llama(TMModel):
             jax.shard_map(
                 step,
                 mesh=mesh,
-                in_specs=(specs, opt_specs, batch_spec, batch_spec, P()),
-                out_specs=(specs, opt_specs, P(), P()),
+                in_specs=(specs, opt_specs, ef_specs, batch_spec,
+                          batch_spec, P()),
+                out_specs=(specs, opt_specs, ef_specs, P(), P()),
             ),
-            donate_argnums=(0, 1),
+            donate_argnums=(0, 1, 2),
             compiler_options=self._compiler_options,
         )
 
@@ -977,6 +1048,52 @@ class Llama(TMModel):
                 init, out_shardings=(shardings, opt_shardings),
                 compiler_options=self._compiler_options,
             )(jax.random.PRNGKey(self.seed))
+        # EF residuals: fresh zeros unless a checkpoint restore
+        # brought them in (then the layout must match — a residual in
+        # the wrong flat order would re-inject rows against the wrong
+        # parameters)
+        if ef_proto and getattr(self, "_restored_ef_orphaned", False):
+            raise ValueError(
+                "a checkpoint restored BEFORE this compile carried an "
+                "EF residual (ef_layout stamped) that load() could "
+                "not attach — the model had no compressed exchange "
+                "yet.  Compiling now would silently zero the "
+                "residual; compile_iter_fns first, then load()"
+            )
+        if ef_proto and getattr(self, "_restored_ef", False):
+            saved = getattr(self, "_restored_ef_layout", None)
+            ok = (
+                saved is not None
+                and tuple(saved) == self._ef_layout
+                and isinstance(self.ef_state, dict)
+                and set(self.ef_state) == set(ef_proto)
+                and all(
+                    tuple(jnp.shape(self.ef_state[k])) == tuple(v.shape)
+                    for k, v in ef_proto.items()
+                )
+            )
+            if not ok:
+                raise ValueError(
+                    "compile_iter_fns with exch_compression after a "
+                    "checkpoint restore found an EF residual that "
+                    "does not match the compiled exchange layout "
+                    "(compression, padded, bucket_len) — compile "
+                    "first, then load(); cross-layout resume is not "
+                    "supported"
+                )
+        elif ef_proto:
+            ef_shardings = jax.tree.map(
+                lambda s: NamedSharding(mesh, s), ef_specs,
+                is_leaf=lambda x: isinstance(x, P),
+            )
+            self.ef_state = jax.jit(
+                lambda: jax.tree.map(
+                    lambda sd: jnp.zeros(sd.shape, sd.dtype), ef_proto
+                ),
+                out_shardings=ef_shardings,
+            )()
+        else:
+            self.ef_state = {}
         self._batch_sharding = NamedSharding(mesh, batch_spec)
 
     def _init_device_cache(self, shard_step) -> None:
@@ -1002,13 +1119,14 @@ class Llama(TMModel):
         # (mesh data axis x b_loc == gb already asserted by
         # compile_iter_fns before this runs)
         specs, opt_specs = self._specs, self._opt_specs
+        ef_specs = self._ef_specs
         rep = NamedSharding(self.mesh, P())
 
         d_size = self.mesh.shape[DATA_AXIS]
         has_exp = EXPERT_AXIS in self.mesh.shape
 
         def make_scan(length: int):
-            def scan_steps(params, opt_state, step, seqs, perm, lr):
+            def scan_steps(params, opt_state, ef, step, seqs, perm, lr):
                 # flat DP replica index, expert-major — must match the
                 # batch spec's (expert, data) shard ordering
                 dme = lax.axis_index(DATA_AXIS)
@@ -1018,7 +1136,7 @@ class Llama(TMModel):
                 nb = perm.shape[0] // gb
 
                 def body(carry, _):
-                    params, opt_state, st = carry
+                    params, opt_state, ef, st = carry
                     i = (st % nb).astype(jnp.int32)
                     idx = lax.dynamic_slice(
                         perm, (i * gb + dme * b_loc,), (b_loc,)
@@ -1030,24 +1148,27 @@ class Llama(TMModel):
                     y = lax.dynamic_slice(
                         rows, (0, sme * t_loc + 1), (b_loc, t_loc)
                     )
-                    params, opt_state, loss, err = shard_step(
-                        params, opt_state, x, y, lr
+                    params, opt_state, ef, loss, err = shard_step(
+                        params, opt_state, ef, x, y, lr
                     )
-                    return (params, opt_state, st + 1), (loss, err)
+                    return (params, opt_state, ef, st + 1), (loss, err)
 
-                (params, opt_state, step), (losses, errs) = lax.scan(
-                    body, (params, opt_state, step), None, length=length
+                (params, opt_state, ef, step), (losses, errs) = lax.scan(
+                    body, (params, opt_state, ef, step), None,
+                    length=length,
                 )
-                return params, opt_state, step, losses, errs
+                return params, opt_state, ef, step, losses, errs
 
             return jax.jit(
                 jax.shard_map(
                     scan_steps,
                     mesh=self.mesh,
-                    in_specs=(specs, opt_specs, P(), P(), P(), P()),
-                    out_specs=(specs, opt_specs, P(), P(), P()),
+                    in_specs=(specs, opt_specs, ef_specs,
+                              P(), P(), P(), P()),
+                    out_specs=(specs, opt_specs, ef_specs,
+                               P(), P(), P()),
                 ),
-                donate_argnums=(0, 1, 2),
+                donate_argnums=(0, 1, 2, 3),
                 compiler_options=self._compiler_options,
             )
 
@@ -1076,12 +1197,14 @@ class Llama(TMModel):
         (
             self.params,
             self.opt_state,
+            self.ef_state,
             self._step_dev,
             losses,
             errs,
         ) = scan_fn(
-            self.params, self.opt_state, self._step_dev,
-            self._seqs_dev, self._perm_dev, self._lr_dev,
+            self.params, self.opt_state, self.ef_state,
+            self._step_dev, self._seqs_dev, self._perm_dev,
+            self._lr_dev,
         )
         recorder.end("calc")
         recorder.train_error(count, losses, errs)
@@ -1109,7 +1232,7 @@ class Llama(TMModel):
         surface as ``ClassifierModel.train_step_cost_analysis``)."""
         x, y = self.put_batch(self.data.train_batch(0))
         return self._train_step.lower(
-            self.params, self.opt_state, x, y,
+            self.params, self.opt_state, self.ef_state, x, y,
             jnp.float32(self.current_lr),
         ).compile().cost_analysis()
 
@@ -1125,8 +1248,15 @@ class Llama(TMModel):
         x, y = self.put_batch(self.data.train_batch(count))
         recorder.end("wait")
         recorder.start()
-        self.params, self.opt_state, loss, err = self._train_step(
-            self.params, self.opt_state, x, y, jnp.float32(self.current_lr)
+        (
+            self.params,
+            self.opt_state,
+            self.ef_state,
+            loss,
+            err,
+        ) = self._train_step(
+            self.params, self.opt_state, self.ef_state, x, y,
+            jnp.float32(self.current_lr),
         )
         recorder.end("calc")
         # device scalars, materialized lazily at the next print window
@@ -1141,7 +1271,10 @@ class Llama(TMModel):
     # -- checkpoint (save/load/adjust_hyperp inherited from TMModel) ------
 
     def checkpoint_trees(self) -> dict[str, PyTree]:
-        return {"params": self.params, "opt_state": self.opt_state}
+        trees = {"params": self.params, "opt_state": self.opt_state}
+        if getattr(self, "ef_state", None):
+            trees["ef_state"] = self.ef_state
+        return trees
 
     def _place_restored(self) -> None:
         if self.mesh is None:
@@ -1155,6 +1288,8 @@ class Llama(TMModel):
 
         self.params = put(self.params, self._specs)
         self.opt_state = put(self.opt_state, self._opt_specs)
+        if getattr(self, "ef_state", None):
+            self.ef_state = put(self.ef_state, self._ef_specs)
 
 
 # Llama-3-8B shape (the BASELINE stretch config), for reference and

@@ -21,7 +21,7 @@ from __future__ import annotations
 import math
 import os
 from functools import partial
-from typing import Any, Callable, Optional, Sequence
+from typing import Any, Callable, Sequence
 
 import jax
 import jax.numpy as jnp

@@ -27,13 +27,17 @@ from theanompi_tpu.parallel.pp import (
 )
 from theanompi_tpu.parallel.exchange import (
     FlatSpec,
+    WIRE_COMPRESSIONS,
     allreduce_mean,
+    compressed_allreduce_mean,
+    dequantize_chunks,
     flat_pack,
     flat_pack_bucket,
     flat_spec,
     flat_spec_cache_clear,
     flat_spec_cache_info,
     flat_unpack,
+    quantize_chunks,
     scatter_update_gather,
     elastic_pair_update,
     elastic_center_merge,
@@ -51,10 +55,12 @@ from theanompi_tpu.parallel.moe import (
     router_topk,
 )
 from theanompi_tpu.parallel.strategies import (
+    COMPRESSION_CHOICES,
     DEFAULT_BUCKET_MB,
     ExchangeStrategy,
     get_strategy,
     resolve_bucket_mb,
+    resolve_compression,
     STRATEGIES,
 )
 
@@ -74,13 +80,17 @@ __all__ = [
     "split_microbatches",
     "merge_microbatches",
     "FlatSpec",
+    "WIRE_COMPRESSIONS",
     "allreduce_mean",
+    "compressed_allreduce_mean",
+    "dequantize_chunks",
     "flat_pack",
     "flat_pack_bucket",
     "flat_spec",
     "flat_spec_cache_clear",
     "flat_spec_cache_info",
     "flat_unpack",
+    "quantize_chunks",
     "scatter_update_gather",
     "elastic_pair_update",
     "elastic_center_merge",
@@ -89,10 +99,12 @@ __all__ = [
     "gossip_merge",
     "gossip_matrix_round",
     "replica_consistency_delta",
+    "COMPRESSION_CHOICES",
     "DEFAULT_BUCKET_MB",
     "ExchangeStrategy",
     "get_strategy",
     "resolve_bucket_mb",
+    "resolve_compression",
     "STRATEGIES",
     "aux_moments",
     "load_balance_loss",

@@ -38,6 +38,8 @@ from typing import Any, Optional
 import jax
 import numpy as np
 
+from theanompi_tpu.parallel.exchange import WIRE_COMPRESSIONS
+
 PyTree = Any
 
 _LEN = struct.Struct(">Q")
@@ -67,27 +69,94 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 # -- streamed array wire ----------------------------------------------------
 
+#: quantized TCP wire codecs (the in-step exchange's int8/fp8 wire,
+#: host-side): name -> qmax the per-LEAF symmetric scale maps amax to.
+#: 4x fewer bytes than fp32 on every fp32 leaf; the scale rides in the
+#: stream header.  Derived from the device codec's table so the two
+#: can never drift (the EASGD sender's local decode must equal the
+#: receiver's — the identity the EF residual depends on).
+WIRE_CODECS = {
+    name: qmax for name, (_, qmax) in WIRE_COMPRESSIONS.items()
+}
+
+
+def _fp8_np_dtype() -> np.dtype:
+    import ml_dtypes  # jax ships it (bf16/fp8 numpy dtypes)
+
+    return np.dtype(ml_dtypes.float8_e4m3fn)
+
+
+def _dtype_from_name(name: str) -> np.dtype:
+    """Resolve a dtype NAME from a stream header — ml_dtypes names
+    (``float8_e4m3fn``, ``bfloat16``) are not numpy built-ins."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
 def _np_dtype(wire) -> Optional[np.dtype]:
     """Resolve a wire-dtype spec (jnp.bfloat16, 'bfloat16', np dtype,
-    None) to a numpy dtype; bf16 comes from ml_dtypes (jax ships it)."""
+    None) to a numpy dtype; bf16 comes from ml_dtypes (jax ships it).
+    Compression names (``int8``/``fp8``) resolve to their 1-byte wire
+    container (the scale handling lives in ``wire_cast``)."""
     if wire is None:
         return None
+    if wire == "int8":
+        return np.dtype(np.int8)
+    if wire == "fp8":
+        return _fp8_np_dtype()
     return np.dtype(wire)
 
 
-def wire_cast(leaves: list, wire) -> tuple[list[np.ndarray], list[str]]:
-    """Host-side leaves + their ORIGINAL dtype names, with fp32 leaves
-    cast to the wire dtype (non-fp32 leaves — int steps, bf16
-    leaves — pass through unchanged)."""
-    wdt = _np_dtype(wire)
-    out, orig = [], []
+def quantize_leaf(a: np.ndarray, compression: str):
+    """Symmetric per-leaf quantization (host-side twin of the in-step
+    ``exchange.quantize_chunks``): fp32 → (1-byte wire array, f32
+    scale)."""
+    qmax = WIRE_CODECS[compression]
+    amax = float(np.max(np.abs(a))) if a.size else 0.0
+    scale = amax / qmax if amax > 0 else 1.0
+    y = a / np.float32(scale)
+    if compression == "int8":
+        w = np.clip(np.rint(y), -qmax, qmax).astype(np.int8)
+    else:
+        w = y.astype(_fp8_np_dtype())
+    return w, scale
+
+
+def dequantize_leaf(w: np.ndarray, scale: float) -> np.ndarray:
+    return w.astype(np.float32) * np.float32(scale)
+
+
+def wire_cast(
+    leaves: list, wire
+) -> tuple[list[np.ndarray], list[str], list]:
+    """Host-side leaves + their ORIGINAL dtype names + per-leaf wire
+    scales, with fp32 leaves cast to the wire dtype (non-fp32 leaves
+    — int steps, bf16 leaves — pass through unchanged).
+
+    ``wire`` may be a plain dtype (bf16: the ``*16`` strategies' 2x)
+    or a compression name from ``WIRE_CODECS`` (``int8``/``fp8``):
+    then fp32 leaves are symmetrically quantized per leaf (4x) and
+    the returned ``scales`` entry is non-``None`` — it must travel in
+    the stream header for the receiver's decode."""
+    comp = wire if wire in WIRE_CODECS else None
+    wdt = None if comp else _np_dtype(wire)
+    out, orig, scales = [], [], []
     for l in leaves:
         a = np.ascontiguousarray(np.asarray(l))
         orig.append(a.dtype.name)
-        if wdt is not None and a.dtype == np.float32:
-            a = a.astype(wdt)
+        scale = None
+        if a.dtype == np.float32:
+            if comp is not None:
+                a, scale = quantize_leaf(a, comp)
+            elif wdt is not None:
+                a = a.astype(wdt)
         out.append(a)
-    return out, orig
+        scales.append(scale)
+    return out, orig, scales
 
 
 def _stream_body(sock: socket.socket, arrs: list[np.ndarray]) -> int:
@@ -108,10 +177,16 @@ def _stream_body(sock: socket.socket, arrs: list[np.ndarray]) -> int:
 
 
 def _send_arrays(sock: socket.socket, arrs: list[np.ndarray],
-                 orig_names: list[str], tag: str = "arrays") -> int:
+                 orig_names: list[str], scales: list | None = None,
+                 tag: str = "arrays") -> int:
     """Stream a leaf list: one small pickled header frame, then the
-    chunked body.  Returns bytes sent (payload only)."""
-    header = [(a.shape, a.dtype.name, o) for a, o in zip(arrs, orig_names)]
+    chunked body.  Quantized leaves carry their per-leaf scale in the
+    header (4-tuple entries).  Returns bytes sent (payload only)."""
+    scales = scales if scales is not None else [None] * len(arrs)
+    header = [
+        (a.shape, a.dtype.name, o, s)
+        for a, o, s in zip(arrs, orig_names, scales)
+    ]
     _send(sock, (tag, header))
     return _stream_body(sock, arrs)
 
@@ -119,16 +194,23 @@ def _send_arrays(sock: socket.socket, arrs: list[np.ndarray],
 def _recv_arrays_body(sock: socket.socket, header) -> tuple[list, int]:
     """Receive the leaf bytes described by ``header``, upcasting each
     leaf back to its ORIGINAL dtype (fp32 accumulation everywhere —
-    the wire dtype never leaks into the math).  Returns (leaves,
+    the wire dtype never leaks into the math); quantized leaves
+    (4-tuple entries with a scale) are dequantized.  Returns (leaves,
     bytes received)."""
     leaves, total = [], 0
-    for shape, wire_name, orig_name in header:
-        wdt = np.dtype(wire_name)
+    for entry in header:
+        shape, wire_name, orig_name = entry[:3]
+        scale = entry[3] if len(entry) > 3 else None
+        wdt = _dtype_from_name(wire_name)
         n = int(np.prod(shape, dtype=np.int64)) * wdt.itemsize
         buf = _recv_exact(sock, n)
         a = np.frombuffer(buf, dtype=wdt).reshape(shape)
-        if orig_name != wire_name:
-            a = a.astype(np.dtype(orig_name))
+        if scale is not None:
+            a = dequantize_leaf(a, scale).astype(
+                _dtype_from_name(orig_name)
+            )
+        elif orig_name != wire_name:
+            a = a.astype(_dtype_from_name(orig_name))
         leaves.append(a)
         total += n
     return leaves, total
@@ -244,16 +326,16 @@ class EASGDCenterServer:
                             _send(conn, ("error", str(e)))
                             continue
                         # reply rides the SAME wire dtype (both
-                        # directions halve); worker upcasts to fp32
-                        arrs, orig = wire_cast(pre, payload)
+                        # directions shrink); worker upcasts to fp32
+                        arrs, orig, scales = wire_cast(pre, payload)
                         _send(conn, ("ok", None))
-                        _send_arrays(conn, arrs, orig)
+                        _send_arrays(conn, arrs, orig, scales)
                     elif cmd == "get":
                         with self._lock:
                             leaves = [l.copy() for l in self._leaves]
-                        arrs, orig = wire_cast(leaves, None)
+                        arrs, orig, scales = wire_cast(leaves, None)
                         _send(conn, ("ok", None))
-                        _send_arrays(conn, arrs, orig)
+                        _send_arrays(conn, arrs, orig, scales)
                     elif cmd == "stats":
                         _send(conn, ("ok", self.stats()))
                     elif cmd == "stop":
@@ -345,13 +427,25 @@ class EASGDCenterClient:
     ``wire`` (e.g. ``"bfloat16"`` / ``jnp.bfloat16``, from the
     exchange strategy's wire dtype — ``asa16``/``nccl16``/``ici16``)
     halves every exchange's bytes in BOTH directions; the elastic
-    math stays fp32 on each end.  ``bytes_sent``/``bytes_received``
-    count streamed payload bytes (the compression is assertable)."""
+    math stays fp32 on each end.  ``wire="int8"``/``"fp8"`` quantizes
+    fp32 leaves per leaf instead (4x, ``WIRE_CODECS``), and with
+    ``error_feedback=True`` the worker carries the push-leg
+    quantization residual and re-injects it into the NEXT push, so
+    the center's time-averaged view of this worker stays unbiased
+    (the pull leg's error is common broadcast rounding — every worker
+    decodes the same bytes — and has no residual to carry).
+    ``bytes_sent``/``bytes_received`` count streamed payload bytes
+    (the compression is assertable)."""
 
     def __init__(self, address: tuple[str, int], connect_timeout: float = 60.0,
-                 wire=None):
+                 wire=None, error_feedback: bool = True):
         self.wire = wire
-        self.wire_name = None if wire is None else _np_dtype(wire).name
+        self.wire_name = (
+            None if wire is None
+            else (wire if wire in WIRE_CODECS else _np_dtype(wire).name)
+        )
+        self.error_feedback = error_feedback and wire in WIRE_CODECS
+        self._ef: list[np.ndarray] | None = None
         self.bytes_sent = 0
         self.bytes_received = 0
 
@@ -405,9 +499,29 @@ class EASGDCenterClient:
         update below runs on the ORIGINAL fp32 values (only the
         counterpart's view of them is rounded)."""
         leaves = _to_host(params)
+        send_leaves = leaves
+        if self.error_feedback:
+            if self._ef is None:
+                self._ef = [
+                    np.zeros_like(l) if l.dtype == np.float32 else None
+                    for l in leaves
+                ]
+            send_leaves = [
+                l + e if e is not None else l
+                for l, e in zip(leaves, self._ef)
+            ]
         _send(self._sock, ("exchange", self.wire_name))
-        arrs, orig = wire_cast(leaves, self.wire)
-        self.bytes_sent += _send_arrays(self._sock, arrs, orig)
+        arrs, orig, scales = wire_cast(send_leaves, self.wire)
+        if self.error_feedback:
+            # residual = what we meant to send minus what the center
+            # decodes (the sender can compute the decode exactly)
+            self._ef = [
+                (inp - dequantize_leaf(a, s)) if s is not None else e
+                for inp, a, s, e in zip(
+                    send_leaves, arrs, scales, self._ef
+                )
+            ]
+        self.bytes_sent += _send_arrays(self._sock, arrs, orig, scales)
         self._check(_recv(self._sock))  # ("ok", None) or error
         center_pre = self._recv_tree_body()
         new_leaves = [

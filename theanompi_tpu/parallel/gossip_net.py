@@ -122,15 +122,15 @@ class GossipPeer:
         goes to the refund queue (the sender halved its score at push
         time; un-merged mass must return home or the cluster's scores
         stop summing to 1)."""
-        arrs, orig = wire_cast(leaves, wire)
-        item = (addr, float(score), arrs, orig)
+        arrs, orig, scales = wire_cast(leaves, wire)
+        item = (addr, float(score), arrs, orig, scales)
         while True:
             try:
                 self._outbox.put_nowait(item)
                 return
             except queue.Full:
                 try:
-                    _, old_score, _arrs, _o = self._outbox.get_nowait()
+                    _, old_score, _arrs, _o, _s = self._outbox.get_nowait()
                     self._outbox.task_done()
                     self.dropped += 1
                     self._refunds.put(old_score)
@@ -153,12 +153,12 @@ class GossipPeer:
             if item is None:
                 self._outbox.task_done()
                 return
-            addr, score, arrs, orig = item
+            addr, score, arrs, orig, scales = item
             try:
                 with socket.create_connection(addr, timeout=30.0) as s:
                     _send(s, ("push", score, [
-                        (a.shape, a.dtype.name, o)
-                        for a, o in zip(arrs, orig)
+                        (a.shape, a.dtype.name, o, sc)
+                        for a, o, sc in zip(arrs, orig, scales)
                     ]))
                     # stream the body through the shared chunked wire
                     # (header already sent above, so bypass its frame)
@@ -188,7 +188,7 @@ class GossipPeer:
         the mass must land SOMEWHERE before scores are compared)."""
         while True:
             try:
-                _, old_score, _arrs, _o = self._outbox.get_nowait()
+                _, old_score, _arrs, _o, _s = self._outbox.get_nowait()
                 self._outbox.task_done()
                 self.dropped += 1
                 self._refunds.put(old_score)

@@ -112,6 +112,32 @@ def resolve_bucket_mb(config: dict | None) -> float:
     return mb
 
 
+# exch_compression: quantized 1-byte wire for the gradient exchange
+# (parallel/exchange quantize/dequantize + all_to_all reduce-scatter),
+# with an error-feedback residual carried in worker state so the
+# quantization error is re-injected next step (error_feedback=True,
+# the default; False drops it — plain QSGD, for A/B only).  ONE
+# resolver (the resolve_bucket_mb pattern) so worker validation,
+# model compile, and the run summary always agree.
+COMPRESSION_CHOICES = ("none", "int8", "fp8")
+
+
+def resolve_compression(config: dict | None) -> tuple[str | None, bool]:
+    """The ``exch_compression`` + ``error_feedback`` config knobs,
+    validated: returns ``(compression, error_feedback)`` where
+    ``compression`` is ``None`` (no compression; unset/"none") or
+    ``"int8"``/``"fp8"``."""
+    c = config or {}
+    comp = c.get("exch_compression", "none") or "none"
+    if comp not in COMPRESSION_CHOICES:
+        raise ValueError(
+            f"unknown exch_compression {comp!r}; known: "
+            f"{COMPRESSION_CHOICES}"
+        )
+    ef = bool(c.get("error_feedback", True))
+    return (None if comp == "none" else comp), ef
+
+
 def get_strategy(name: str) -> ExchangeStrategy:
     try:
         return STRATEGIES[name]

@@ -129,7 +129,15 @@ def load_checkpoint(
             for k, v in flat.items()
             if k.startswith(group + ":")
         }
-        out[group] = dict_to_tree(sub, tree)
+        try:
+            out[group] = dict_to_tree(sub, tree)
+        except KeyError as e:
+            # name the GROUP: callers dispatch on it (a missing
+            # ef_state residual gets a different remedy than a
+            # mismatched opt_state tree)
+            raise KeyError(
+                f"group {group!r}: {e.args[0] if e.args else e}"
+            ) from e
     meta_path = path.with_suffix(".json")
     meta = json.loads(meta_path.read_text()) if meta_path.exists() else {}
     for k in _INTERNAL_META:

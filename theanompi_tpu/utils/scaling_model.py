@@ -78,14 +78,109 @@ def ici_links_used(n_chips: int) -> int:
 
 
 def allreduce_time(wire_bytes: float, n_chips: int,
-                   chip: ChipSpec = V5E, links: int | None = None) -> float:
+                   chip: ChipSpec = V5E, links: int | None = None,
+                   bw: float | None = None) -> float:
     """Bidirectional ring/torus allreduce seconds for ``wire_bytes``
-    per chip (reduce-scatter + all-gather: 2*B*(n-1)/n on the wire)."""
+    per chip (reduce-scatter + all-gather: 2*B*(n-1)/n on the wire).
+    ``bw`` overrides the per-chip egress (bytes/s) — the DCN case,
+    where the ring crosses host NICs instead of ICI links."""
     if n_chips <= 1:
         return 0.0
-    links = ici_links_used(n_chips) if links is None else links
-    bw = links * chip.ici_link_bw
+    if bw is None:
+        links = ici_links_used(n_chips) if links is None else links
+        bw = links * chip.ici_link_bw
     return 2.0 * wire_bytes * (n_chips - 1) / n_chips / bw
+
+
+# --------------------------------------------------------------------------
+# compressed wire (exch_compression int8/fp8 — parallel/exchange)
+# --------------------------------------------------------------------------
+
+#: bytes per gradient element each wire format ships (the fp32 master
+#: is 4 bytes/element; the compression factor is 4/this)
+WIRE_ELEM_BYTES = {
+    "fp32": 4.0, None: 4.0, "none": 4.0,
+    "bf16": 2.0,
+    "int8": 1.0, "fp8": 1.0,
+}
+
+
+def exchange_wire_bytes(
+    param_bytes: float,
+    *,
+    wire: str | None = None,
+    n_shards: int = 8,
+    bucket_bytes: float = 4 * 2**20,
+) -> float:
+    """Bytes ONE phase of the exchange puts on the wire per chip-step
+    for a ``param_bytes`` fp32 gradient pack.  The compressed wire
+    (``int8``/``fp8``) ships 1 byte per element plus one f32 scale
+    per (bucket x shard) chunk — the scale overhead is what makes
+    tiny buckets lose (PERFORMANCE.md: when int8 loses)."""
+    n_elems = param_bytes / 4.0
+    per_elem = WIRE_ELEM_BYTES[wire]
+    payload = n_elems * per_elem
+    if per_elem == 1.0:
+        n_buckets = max(1.0, math.ceil(param_bytes / bucket_bytes))
+        payload += 4.0 * n_buckets * n_shards
+    return payload
+
+
+def compression_table(
+    *,
+    step_time_1chip: float,
+    param_bytes: float,
+    wire: str = "int8",
+    baseline_wire: str = "fp32",
+    chip_counts=(8, 16, 64),
+    transport: str = "ici",
+    chip: ChipSpec = V5E,
+    overlap_frac: float = 2.0 / 3.0,
+    bucket_bytes: float = 4 * 2**20,
+) -> list[dict]:
+    """Predicted win of the quantized wire over ``baseline_wire`` at
+    8/16/64 chips — the ISSUE's motivating number: at 16-64 chips
+    over DCN the baseline's ``exposed_comm_frac`` dominates the step,
+    and cutting wire bytes 4x shrinks it directly.
+
+    ``transport="dcn"`` rings over the hosts' NIC share
+    (``chip.dcn_bw_per_chip``) instead of ICI — the multi-host regime
+    the compression is FOR (ICI at 8 chips usually hides the fp32
+    wire already; the model shows exactly that).
+
+    One row per chip count::
+
+        {"n_chips", "wire_mb", "wire_mb_baseline",
+         "wire_reduction", "t_exposed_ms", "t_exposed_baseline_ms",
+         "efficiency", "efficiency_baseline", "speedup"}
+    """
+    rows = []
+    for n in chip_counts:
+        bw = chip.dcn_bw_per_chip if transport == "dcn" else None
+        out = {}
+        for label, w in (("", wire), ("_baseline", baseline_wire)):
+            wb = exchange_wire_bytes(
+                param_bytes, wire=w, n_shards=n,
+                bucket_bytes=bucket_bytes,
+            )
+            t_ar = allreduce_time(wb, n, chip, bw=bw)
+            exposed = max(0.0, t_ar - overlap_frac * step_time_1chip)
+            out[f"wire_mb{label}"] = wb / 2**20
+            out[f"t_exposed{label}_ms"] = exposed * 1e3
+            out[f"efficiency{label}"] = step_time_1chip / (
+                step_time_1chip + exposed
+            )
+        rows.append({
+            "n_chips": n,
+            "transport": transport,
+            "wire": wire,
+            "wire_reduction": (
+                out["wire_mb_baseline"] / out["wire_mb"]
+            ),
+            "speedup": out["efficiency"] / out["efficiency_baseline"],
+            **out,
+        })
+    return rows
 
 
 def bsp_efficiency(
@@ -96,6 +191,7 @@ def bsp_efficiency(
     n_chips: int,
     chip: ChipSpec = V5E,
     overlap_frac: float = 2.0 / 3.0,
+    compression: str | None = None,
 ) -> dict:
     """Predicted BSP scaling efficiency at ``n_chips`` (per-chip batch
     held constant — the reference's weak-scaling regime, SURVEY §6).
@@ -108,8 +204,16 @@ def bsp_efficiency(
     ``overlap_frac``: fraction of compute the allreduce can hide
     under (default: the backward ~2/3 of a fwd+bwd step, which is
     where XLA schedules grad collectives).
+    ``compression`` (``int8``/``fp8``): the quantized wire — 1 byte
+    per gradient element + per-chunk scales (supersedes
+    ``wire_dtype_bytes``; ``exchange_wire_bytes``).
     """
-    wire_bytes = param_bytes * wire_dtype_bytes / 4.0
+    if compression in ("int8", "fp8"):
+        wire_bytes = exchange_wire_bytes(
+            param_bytes, wire=compression, n_shards=n_chips
+        )
+    else:
+        wire_bytes = param_bytes * wire_dtype_bytes / 4.0
     t_ar = allreduce_time(wire_bytes, n_chips, chip)
     exposed = max(0.0, t_ar - overlap_frac * step_time_1chip)
     eff_overlap = step_time_1chip / (step_time_1chip + exposed)

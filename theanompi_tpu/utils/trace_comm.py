@@ -64,6 +64,20 @@ CPU_WRAPPER_MARKERS = (
     "thunkexecutor::",
     "pjrtcpuexecutable::",
     "executehelper",
+    "threadpoollistener",
+)
+
+# XLA:CPU execution-lane prefixes (the per-device client threads and
+# the intra-op pools where warm thunks actually run).  The client
+# class name varies with the runtime build — PjRtCpuClient on some
+# jax builds, TfrtCpuClient on this image's 0.4.x (verified: its
+# absence was why CPU-mesh traces reported n_cores == 0 and the bench
+# llama row emitted a null exposed_comm_frac) — so every known
+# spelling is matched.
+CPU_LANE_PREFIXES = (
+    "tf_xlapjrtcpuclient",
+    "tf_xlatfrtcpuclient",
+    "tf_xlaeigen",
 )
 
 
@@ -89,16 +103,78 @@ def capture_trace(fn: Callable[[], Any], trace_dir: str) -> Any:
     return out
 
 
-def report_of(fn: Callable[[], Any], top_n: int = 15) -> dict:
+def report_of(fn: Callable[[], Any], top_n: int = 15,
+              quant_ops: set | None = None) -> dict:
     """Capture ``fn`` into a temp dir and return its ``comm_report``
     — the one-shot capture-and-attribute recipe shared by bench.py
     and the multichip gate (``fn`` must fence its own device work,
-    e.g. by a value read)."""
+    e.g. by a value read).  ``quant_ops`` — instruction names from
+    ``scope_op_names`` to attribute as quantize/dequantize compute."""
     import tempfile
 
     with tempfile.TemporaryDirectory() as td:
         capture_trace(fn, td)
-        return comm_report(td, top_n=top_n)
+        return comm_report(td, top_n=top_n, quant_ops=quant_ops)
+
+
+# -- quantize/dequantize attribution (exch_compression) ---------------------
+#
+# The quantize/dequantize of the compressed exchange lowers to fused
+# elementwise HLO whose instruction names carry no hint of their
+# origin ("convert_slice_fusion.2") — but the OPTIMIZED HLO keeps
+# per-instruction metadata with the jax name-stack, and exchange.py
+# wraps both codec halves in jax.named_scope("quantize_wire" /
+# "dequantize_wire").  So the recipe is: extract the instruction
+# names whose metadata op_name mentions those scopes from the
+# compiled module's text, then hand the set to comm_report — trace
+# events matching it are summed as ``quant_s`` (still compute for
+# the hidden/exposed split: quantize work genuinely hides wire time).
+
+QUANT_SCOPE_MARKERS = ("quantize_wire", "dequantize_wire")
+
+_HLO_INSTR_RE = None
+
+
+def scope_op_names(hlo_text: str,
+                   markers: tuple = QUANT_SCOPE_MARKERS) -> set[str]:
+    """Instruction names (no ``%``) whose ``metadata={op_name=...}``
+    mentions any of ``markers`` — matches the event names the
+    profiler emits for those instructions.  Names from inside fused
+    computations are included too; they never collide with top-level
+    names (HLO instruction names are module-unique), so the extras
+    are harmless."""
+    global _HLO_INSTR_RE
+    import re
+
+    if _HLO_INSTR_RE is None:
+        _HLO_INSTR_RE = re.compile(
+            r"%([\w.\-]+)\s*=.*?op_name=\"([^\"]*)\""
+        )
+    out = set()
+    for m in _HLO_INSTR_RE.finditer(hlo_text):
+        name, op_name = m.group(1), m.group(2)
+        if any(mk in op_name for mk in markers):
+            out.add(name)
+    return out
+
+
+def compiled_hlo_text(compiled) -> str:
+    """Optimized-HLO text of a jax ``Compiled`` across the API
+    variants this image's jax versions expose."""
+    try:
+        return "\n".join(
+            m.to_string()
+            for m in compiled.runtime_executable().hlo_modules()
+        )
+    except Exception:
+        return compiled.as_text()
+
+
+def quant_op_names(lowered) -> set[str]:
+    """``scope_op_names`` of a jax ``Lowered`` (compiles it — with the
+    persistent compile cache this deserializes the already-built
+    executable)."""
+    return scope_op_names(compiled_hlo_text(lowered.compile()))
 
 
 def _latest_xplanes(trace_dir: str) -> list[str]:
@@ -172,7 +248,8 @@ def _subtract(a: list[tuple[int, int]],
     return out
 
 
-def comm_report(trace_dir: str, top_n: int = 15) -> dict:
+def comm_report(trace_dir: str, top_n: int = 15,
+                quant_ops: set | None = None) -> dict:
     """Parse the newest trace run under ``trace_dir`` into an
     overlap-aware comm/compute attribution.
 
@@ -195,6 +272,7 @@ def comm_report(trace_dir: str, top_n: int = 15) -> dict:
         {"device_busy_s", "collective_s", "exposed_comm_s",
          "exposed_comm_frac", "hidden_comm_s", "comm_frac",
          "overlapped_comm_s", "overlapped_comm_frac",
+         "quant_s", "quant_frac",
          "n_cores", "top_collectives": [(name, seconds), ...]}
 
     ``overlapped_comm_s`` is collective time running CONCURRENTLY with
@@ -204,6 +282,12 @@ def comm_report(trace_dir: str, top_n: int = 15) -> dict:
     ``overlapped_comm_frac`` is its share of total collective time —
     1.0 means every collective second was hidden behind compute, 0.0
     means the exchange ran as a fully serialized tail.
+
+    ``quant_ops`` (from ``scope_op_names``): instruction names of the
+    compressed exchange's quantize/dequantize — their time is summed
+    as ``quant_s``/``quant_frac`` (share of busy), the compute the
+    wire compression COSTS, reported alongside what it saves.  Quant
+    events still count as compute in the hidden/exposed split.
     """
     xplane_pb2 = _xplane_pb2()
 
@@ -215,6 +299,8 @@ def comm_report(trace_dir: str, top_n: int = 15) -> dict:
     cores: dict[tuple[int, str, int], dict[str, list]] = {}
     per_op: dict[str, int] = {}
     per_op_all: dict[str, int] = {}
+    quant_ps_box = [0]
+    quant_ops = quant_ops or set()
 
     def _record(core, op, s, e, *, comm):
         per_op_all[op] = per_op_all.get(op, 0) + (e - s)
@@ -223,6 +309,8 @@ def comm_report(trace_dir: str, top_n: int = 15) -> dict:
             per_op[op] = per_op.get(op, 0) + (e - s)
         else:
             core["compute"].append((s, e))
+            if op in quant_ops:
+                quant_ps_box[0] += e - s
 
     for pi, path in enumerate(_latest_xplanes(trace_dir)):
         space = xplane_pb2.XSpace()
@@ -245,9 +333,7 @@ def comm_report(trace_dir: str, top_n: int = 15) -> dict:
                     # actually run their thunks (verified: convolution
                     # / all-reduce / Rendezvous events live on
                     # tf_XLAEigen lines once the executable is warm)
-                    if lname.startswith(
-                        ("tf_xlapjrtcpuclient", "tf_xlaeigen")
-                    ):
+                    if lname.startswith(CPU_LANE_PREFIXES):
                         sync_lines.append((li, line, "cpu_thread"))
                 elif "async" in lname and "xla ops" in lname:
                     async_lines.append((li, line))
@@ -313,6 +399,7 @@ def comm_report(trace_dir: str, top_n: int = 15) -> dict:
     busy_s = busy_ps * ps
     comm_s = comm_ps * ps
     exposed_s = exposed_ps * ps
+    quant_s = quant_ps_box[0] * ps
     top = sorted(per_op.items(), key=lambda kv: -kv[1])[:8]
     return {
         "device_busy_s": busy_s,
@@ -320,6 +407,8 @@ def comm_report(trace_dir: str, top_n: int = 15) -> dict:
         "exposed_comm_s": exposed_s,
         "hidden_comm_s": comm_s - exposed_s,
         "overlapped_comm_s": comm_s - exposed_s,
+        "quant_s": quant_s,
+        "quant_frac": (quant_s / busy_s) if busy_s else 0.0,
         "comm_frac": (comm_s / busy_s) if busy_s else 0.0,
         "exposed_comm_frac": (exposed_s / busy_s) if busy_s else 0.0,
         "overlapped_comm_frac": (

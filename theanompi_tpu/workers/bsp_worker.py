@@ -84,16 +84,21 @@ def run(
     # build so a typo'd name fails in milliseconds, and so the run
     # summary can carry the resolved name (zero1 runs shard their
     # optimizer state — the checkpoint format follows)
-    from theanompi_tpu.parallel import get_strategy, resolve_bucket_mb
+    from theanompi_tpu.parallel import (
+        get_strategy,
+        resolve_bucket_mb,
+        resolve_compression,
+    )
 
     strat = get_strategy(
         exch_strategy or cfg.get("exch_strategy", "ici32")
     )
-    # bucketed-exchange knob, validated here for the same reason as
-    # the strategy name: a bad value must fail before the model build
-    # (resolve_bucket_mb is the ONE resolver — the models' step
-    # bodies read the same rule, so summary and compile agree)
+    # bucketed-exchange + compression knobs, validated here for the
+    # same reason as the strategy name: a bad value must fail before
+    # the model build (resolve_* are the ONE resolvers — the models'
+    # step bodies read the same rules, so summary and compile agree)
     bucket_mb = resolve_bucket_mb(cfg)
+    compression, error_feedback = resolve_compression(cfg)
     mesh = _build_mesh(devices, cfg)
     n_replicas = dp_replicas(mesh)
     if n_epochs is not None:
@@ -120,7 +125,12 @@ def run(
             f"exchange={strat.name}"
             + (" (ZeRO-1 sharded optimizer)" if strat.zero1 else "")
             + (f", buckets {bucket_mb:g} MiB" if bucket_mb else
-               ", monolithic exchange"),
+               ", monolithic exchange")
+            + (
+                f", {compression} wire"
+                + ("+EF" if error_feedback else " (no EF)")
+                if compression else ""
+            ),
             flush=True,
         )
 
@@ -205,6 +215,8 @@ def run(
         "epochs": model.epoch,
         "exch_strategy": strat.name,
         "exchange_bucket_mb": bucket_mb,
+        "exch_compression": compression or "none",
+        "error_feedback": bool(compression) and error_feedback,
         "iterations": recorder.n_iter,
         "final_train_loss": (
             recorder.train_losses[-1] if recorder.train_losses else None

@@ -425,13 +425,20 @@ def _run_distributed(
             addr = client.blocking_key_value_get("tm_easgd_center", 60000)
     # the strategy knob's wire dtype applies to the TCP exchange too
     # (the reference's asa16/nccl16 fp16 wire, SURVEY §5.8): *16
-    # configs ship bf16 leaves both ways, elastic math stays fp32
-    from theanompi_tpu.parallel import get_strategy
+    # configs ship bf16 leaves both ways, elastic math stays fp32.
+    # exch_compression supersedes it with the int8/fp8 per-leaf
+    # quantized codec (4x) — the worker carries a push-leg EF residual
+    # inside the client so its time-averaged contribution to the
+    # center stays unbiased.
+    from theanompi_tpu.parallel import get_strategy, resolve_compression
 
-    wire = get_strategy(cfg.get("exch_strategy", "ici32")).wire_dtype
+    comp, use_ef = resolve_compression(cfg)
+    wire = comp or get_strategy(
+        cfg.get("exch_strategy", "ici32")
+    ).wire_dtype
     tcp = EASGDCenterClient(
         (addr.rsplit(":", 1)[0], int(addr.rsplit(":", 1)[1])),
-        wire=wire,
+        wire=wire, error_feedback=use_ef,
     )
 
     data = model.data

@@ -405,10 +405,18 @@ def _run_distributed(
         push_prob if push_prob is not None else cfg.get("push_prob", 0.25)
     )
     # *16 strategies put bf16 on the gossip wire (halves push bytes
-    # AND outbox memory); the score-weighted merge stays fp32
-    from theanompi_tpu.parallel import get_strategy
+    # AND outbox memory); the score-weighted merge stays fp32.
+    # exch_compression supersedes it: int8/fp8 per-leaf quantized
+    # pushes (4x smaller payloads AND outbox).  No EF residual here —
+    # a gossip push's receiver set is random and unacknowledged, so
+    # there is no single counterpart whose view a residual could
+    # unbias; the score-weighted merge dilutes the per-push rounding
+    # instead (documented in PERFORMANCE.md).
+    from theanompi_tpu.parallel import get_strategy, resolve_compression
 
-    wire = get_strategy(cfg.get("exch_strategy", "ici32")).wire_dtype
+    wire = resolve_compression(cfg)[0] or get_strategy(
+        cfg.get("exch_strategy", "ici32")
+    ).wire_dtype
     recorder = Recorder(
         rank=pid, size=n_procs, print_freq=print_freq, verbose=verbose
     )
