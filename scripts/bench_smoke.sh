@@ -1,11 +1,17 @@
 #!/usr/bin/env bash
-# Compressed-exchange smoke: the bench.py `compressed` A/B arm at
-# 5 steps x 4 arms (fp32 / int8+EF / fp8+EF / zero1+int8) on the
-# virtual 8-device CPU mesh — a ~2-minute signal that the quantized
-# wire still compiles, runs, traces, and tracks the fp32 loss, for
-# CI and pre-commit use.  The full 50-step protocol is the bench row
-# (TM_BENCH_MODEL=compressed) and the slow-tier tests
-# (tests/test_compression.py --runslow).
+# Bench smokes on the virtual 8-device CPU mesh, for CI and
+# pre-commit use:
+#
+# 1. compressed-exchange: the bench.py `compressed` A/B arm at
+#    5 steps x 4 arms (fp32 / int8+EF / fp8+EF / zero1+int8) — a
+#    ~2-minute signal that the quantized wire still compiles, runs,
+#    traces, and tracks the fp32 loss.  The full 50-step protocol is
+#    the bench row (TM_BENCH_MODEL=compressed) and the slow-tier
+#    tests (tests/test_compression.py --runslow).
+# 2. serving: the bench.py `serving` row in smoke shape — 4
+#    concurrent prompts through the continuous batcher at 8 tokens
+#    each off a just-saved training checkpoint; asserts every
+#    request completes (none shed, none hung) and tokens flowed.
 #
 # Usage: bash scripts/bench_smoke.sh
 
@@ -27,5 +33,20 @@ if bad:
 wr = row.get("wire_reduction", 0)
 if not wr >= 3.5:
     sys.exit("bench_smoke: wire_reduction below 3.5x: %s" % wr)
-print("bench_smoke: OK")
+print("bench_smoke: compressed OK")
+'
+
+out=$(TM_SERVING_SMOKE=1 TM_BENCH_MODEL=serving python bench.py)
+printf '%s\n' "$out" | python -c '
+import json, sys
+row = json.loads(sys.stdin.readline())
+arm = row["arms"]["offered_4"]
+print("serving tokens/s", arm.get("tokens_per_sec"),
+      "ttft p50/p95", arm.get("ttft_p50_s"), arm.get("ttft_p95_s"))
+if arm["n_completed"] != 4 or arm["n_shed"] != 0:
+    sys.exit("bench_smoke: serving arm did not complete all 4 "
+             "requests: %s" % arm)
+if not (arm["tokens_completed"] == 4 * 8 and arm["tokens_per_sec"] > 0):
+    sys.exit("bench_smoke: serving arm token accounting off: %s" % arm)
+print("bench_smoke: serving OK")
 '

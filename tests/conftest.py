@@ -45,13 +45,83 @@ def pytest_addoption(parser):
     )
 
 
+# The 18 long-standing tier-1 failures on SHIMMED (0.4.x) jax —
+# unchanged since PR 1: the vma-checked shard_map autodiff these tests
+# exercise (tp>1/sp>1 collective-transpose insertion) and the current
+# Pallas flash-kernel surface have no 0.4.x equivalent, so compat.py's
+# unchecked-mode shims cannot express them.  On a current jax
+# (compat.SHIMMED False) they run — and must pass — normally.
+#
+# EXACT set, asserted below: the xfail mark is applied per-nodeid, so
+# a NEW failure can never hide behind it, and a rename/removal of a
+# listed test fails collection loudly instead of orphaning the mark.
+VMA_GRAD_XFAILS = frozenset({
+    "tests/test_llama.py::TestLayoutInvariance::test_val_loss_same_on_1x1x1_and_2x2x2",
+    "tests/test_llama.py::TestLayoutInvariance::test_val_loss_same_with_pipeline_parallel",
+    "tests/test_llama.py::TestLayoutInvariance::test_first_step_loss_matches_full_4d_layout",
+    "tests/test_llama.py::TestLayoutInvariance::test_chunked_head_matches_dense",
+    "tests/test_moe.py::TestExpertParallelLayouts::test_two_step_train_loss_invariant_ep2_and_tp2",
+    "tests/test_moe.py::TestExpertParallelLayouts::test_ep_composes_with_pp",
+    "tests/test_pp.py::TestGradients::test_loss_and_grads_match_sequential",
+    "tests/test_ring_attention.py::TestFlashKernel::test_matches_dense_multiblock[False]",
+    "tests/test_ring_attention.py::TestFlashKernel::test_matches_dense_multiblock[True]",
+    "tests/test_ring_attention.py::TestFlashKernel::test_grads_match_dense_multiblock[False]",
+    "tests/test_ring_attention.py::TestFlashKernel::test_grads_match_dense_multiblock[True]",
+    "tests/test_ring_attention.py::TestFlashKernel::test_independent_backward_blocks_same_grads",
+    "tests/test_ring_attention.py::TestRingFlash::test_forward_matches_dense_ring[1-False]",
+    "tests/test_ring_attention.py::TestRingFlash::test_forward_matches_dense_ring[1-True]",
+    "tests/test_ring_attention.py::TestRingFlash::test_forward_matches_dense_ring[2-False]",
+    "tests/test_ring_attention.py::TestRingFlash::test_forward_matches_dense_ring[2-True]",
+    "tests/test_ring_attention.py::TestRingFlash::test_grads_match_dense_ring[False]",
+    "tests/test_ring_attention.py::TestRingFlash::test_grads_match_dense_ring[True]",
+})
+_XFAIL_REASON = (
+    "jax 0.4.x cannot express this: vma-checked shard_map autodiff "
+    "(tp>1/sp>1 collective transposes) / current Pallas kernel "
+    "surface are absent under the compat.py shims (SHIMMED jax; "
+    "see CHANGES.md PR 1)"
+)
+
+
 def pytest_collection_modifyitems(config, items):
     """Two test tiers (VERDICT r3 #8): the DEFAULT invocation
     (``pytest -q tests/``) must finish in minutes on a 1-core host —
     every compile in it is one the persistent cache amortizes.  The
     slow tier (``--runslow`` or ``TM_SLOW_TESTS=1``) adds the
     multi-process drills and convergence runs; docs/PODS.md documents
-    both wall times."""
+    both wall times.
+
+    Additionally (ISSUE 5 satellite): on a SHIMMED 0.4.x jax the 18
+    known-inexpressible failures above are marked strict xfail — an
+    unexpected pass fails, a new failure is never masked, and the set
+    itself is asserted exact per collected file."""
+    from theanompi_tpu import compat
+
+    if compat.SHIMMED:
+        found = set()
+        xfail = pytest.mark.xfail(reason=_XFAIL_REASON, strict=True)
+        for item in items:
+            if item.nodeid in VMA_GRAD_XFAILS:
+                item.add_marker(xfail)
+                found.add(item.nodeid)
+        # exact-set assertion, scoped to fully-collected files so
+        # single-test invocations don't false-alarm: whenever a whole
+        # listed FILE was collected (no `::` selection args), every
+        # listed nodeid in it must exist — a rename/remove must update
+        # the list, not silently orphan the mark
+        if not any(
+            "::" in a for a in config.invocation_params.args
+        ) and not config.option.keyword:
+            collected_files = {i.nodeid.split("::")[0] for i in items}
+            missing = {
+                nid for nid in VMA_GRAD_XFAILS - found
+                if nid.split("::")[0] in collected_files
+            }
+            assert not missing, (
+                f"conftest VMA_GRAD_XFAILS is stale — listed tests "
+                f"not collected: {sorted(missing)}"
+            )
+
     if config.getoption("--runslow") or os.environ.get(
         "TM_SLOW_TESTS"
     ) == "1":

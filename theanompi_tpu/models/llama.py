@@ -106,6 +106,20 @@ def rope(x, pos, theta=10000.0):
     return jnp.stack([y1, y2], axis=-1).reshape(x.shape).astype(x.dtype)
 
 
+def rope_at(x, pos, theta=10000.0):
+    """Rotary embedding at PER-ROW positions (the KV-cache decode
+    path, where every slot sits at its own sequence position).
+    x: [S, H, D], pos: [S].  Implemented as a vmap of ``rope`` so
+    there is ONE copy of the rotation math — a token rotated here
+    matches the same token rotated by the training forward at the
+    same position bit-for-bit by construction."""
+    return jax.vmap(
+        lambda xs, p: rope(xs[None, :, None, :], p[None], theta)[
+            0, :, 0, :
+        ]
+    )(x, pos)
+
+
 def _heads(x, n, d):
     """[B, T, n*d] -> [B, n, T, d]"""
     b, t, _ = x.shape
@@ -1267,6 +1281,16 @@ class Llama(TMModel):
         x, y = self.put_batch(self.data.val_batch(count))
         loss, err, err5 = self._val_step(self.params, x, y)
         return float(loss), float(err), float(err5)
+
+    # -- serving (theanompi_tpu/serving) ----------------------------------
+
+    def make_decoder(self, **kw):
+        """KV-cache inference decoder over this model's (compiled,
+        possibly checkpoint-restored) params — the train → checkpoint
+        → serve path.  See ``theanompi_tpu.serving.LlamaDecoder``."""
+        from theanompi_tpu.serving import LlamaDecoder
+
+        return LlamaDecoder(self, **kw)
 
     # -- checkpoint (save/load/adjust_hyperp inherited from TMModel) ------
 

@@ -88,19 +88,56 @@ def sharded_softmax_xent(
     return jnp.mean(lse - tgt)
 
 
+def sharded_argmax(score_loc, vocab: int, axis_name: str = MODEL_AXIS):
+    """Global argmax over a vocab-sharded score row [..., V/tp] via
+    the (value, id) max-reduction trick.  Ties break to the LOWEST
+    global id — both across shards (pmin over tying shards) and
+    within a shard (jnp.argmax returns the first maximum) — so the
+    result is deterministic and layout-invariant: tp=1 and tp=8 pick
+    the same token for the same global score row."""
+    v_loc, off = vocab_shard_info(vocab, axis_name)
+    loc_max = jnp.max(score_loc, axis=-1)
+    loc_arg = jnp.argmax(score_loc, axis=-1).astype(jnp.int32) + off
+    gmax = lax.pmax(loc_max, axis_name)
+    cand = jnp.where(loc_max >= gmax, loc_arg, vocab)
+    return lax.pmin(cand, axis_name)
+
+
+def sharded_sample(logits_loc, vocab: int, keys, temperature,
+                   axis_name: str = MODEL_AXIS):
+    """One token id per row from vocab-sharded logits [N, V/tp].
+
+    ``temperature <= 0`` rows decode greedily (pure argmax, lowest-id
+    tie-break); positive rows sample via the Gumbel-max trick:
+    ``argmax(logits/T + g)`` with ``g ~ Gumbel(0,1)`` is an exact
+    draw from ``softmax(logits/T)``.  The Gumbel noise is drawn for
+    the FULL vocab from each row's key and sliced to the local
+    columns, so the perturbed scores — and therefore the sampled
+    ids — are bitwise layout-invariant across tp meshes (the
+    serving determinism contract; tests/test_serving.py).
+
+    ``keys``: [N, 2] uint32 PRNG keys, one per row (already folded
+    with the row's position — the caller owns the fold policy).
+    Returns [N] int32 global token ids.
+    """
+    v_loc, off = vocab_shard_info(vocab, axis_name)
+    x = logits_loc.astype(jnp.float32)
+    g = jax.vmap(
+        lambda k: jax.random.gumbel(k, (vocab,), jnp.float32)
+    )(keys)
+    g_loc = lax.dynamic_slice(g, (0, off), (g.shape[0], v_loc))
+    t = jnp.maximum(temperature, 1e-6)[:, None]
+    score = jnp.where(temperature[:, None] > 0.0, x / t + g_loc, x)
+    return sharded_argmax(score, vocab, axis_name)
+
+
 def sharded_top1_err(logits_loc, labels, vocab: int,
                      axis_name: str = MODEL_AXIS):
-    """Top-1 error with sharded vocab: global argmax via the
-    (value, id) max-reduction trick."""
-    v_loc, off = vocab_shard_info(vocab, axis_name)
+    """Top-1 error with sharded vocab: global argmax via
+    ``sharded_argmax``."""
     # metrics carry no gradient; keeps pmax/pmin off the JVP path
     x = lax.stop_gradient(logits_loc).astype(jnp.float32)
-    loc_max = jnp.max(x, axis=-1)
-    loc_arg = jnp.argmax(x, axis=-1) + off
-    gmax = lax.pmax(loc_max, axis_name)
-    # lowest global id among tying shards wins (deterministic)
-    cand = jnp.where(loc_max >= gmax, loc_arg, vocab)
-    pred = lax.pmin(cand, axis_name)
+    pred = sharded_argmax(x, vocab, axis_name)
     return jnp.mean((pred != labels).astype(jnp.float32))
 
 

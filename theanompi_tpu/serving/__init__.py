@@ -1,0 +1,34 @@
+"""Continuous-batching inference serving (the roadmap's "serve heavy
+traffic" workload): KV-cache decode for Llama + a slot-based engine.
+
+- ``decoder`` — model layer: tp-sharded GQA KV cache, bucketed
+  ``prefill`` + single-token ``decode_step``, layout-invariant
+  greedy/temperature samplers (``parallel/tp.py``).
+- ``engine`` — Orca-style continuous batcher behind a thread-safe
+  ``Engine.submit()`` front-end with admission control (queue cap +
+  per-request deadlines → load-shed results, never hangs).
+
+See docs/SERVING.md for lifecycle, knobs and telemetry.
+"""
+
+from theanompi_tpu.serving.decoder import (
+    LlamaDecoder,
+    decoder_from_checkpoint,
+    default_prefill_buckets,
+)
+from theanompi_tpu.serving.engine import (
+    Engine,
+    Request,
+    Result,
+    ServingFuture,
+)
+
+__all__ = [
+    "Engine",
+    "LlamaDecoder",
+    "Request",
+    "Result",
+    "ServingFuture",
+    "decoder_from_checkpoint",
+    "default_prefill_buckets",
+]

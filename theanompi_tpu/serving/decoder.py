@@ -1,0 +1,420 @@
+"""Model layer of the serving engine: GQA-aware, tp-sharded KV-cache
+decode for ``models/llama.py``.
+
+Two fixed-shape jitted functions per decoder (the vLLM/Orca split):
+
+- ``prefill`` — run one request's prompt through the full causal
+  forward (the training ``flash_attention`` path, sp=1), write its
+  K/V into the request's cache SLOT, and sample the first output
+  token.  Prompt lengths are BUCKETED (padded up to the next bucket
+  size) so the number of compiled prefill executables is bounded by
+  the bucket count, not by the number of distinct prompt lengths.
+- ``decode_step`` — one token for ALL slots at once: embed each
+  slot's current token, append its K/V at the slot's position, attend
+  over the slot's cached history, sample the next token.  Slots are
+  mathematically independent rows (per-row matmuls, per-slot
+  attention, per-slot PRNG keys folded with the token POSITION), so a
+  request decoded in a full batch is bitwise-equal to the same
+  request decoded alone — the property continuous batching needs to
+  be a scheduling choice rather than a math choice.
+
+Sharding: weights keep the training layout (``Llama.param_specs`` —
+QKV/gate/up column-parallel, o/down row-parallel, vocab sharded
+through embed/head); the KV cache shards its KV-HEAD dim over the
+``model`` axis, so each tp shard caches exactly the heads it
+computes.  The samplers (``parallel/tp.py``: ``sharded_argmax`` /
+``sharded_sample``) combine over the model axis with the (value, id)
+max-reduction trick and full-vocab Gumbel draws, which makes sampled
+ids bitwise layout-invariant across tp=1 vs tp>1 meshes.
+
+Everything runs in unchecked manual mode (``check_vma=False``) with
+explicit collectives only — the forward-only serving path works
+identically on the 0.4.x-shimmed jax (``compat.py``) and current jax.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from theanompi_tpu.models.llama import (
+    Llama,
+    _heads,
+    _unheads,
+    rms_norm,
+    rope,
+    rope_at,
+)
+from theanompi_tpu.ops.attention import NEG_INF, flash_attention
+from theanompi_tpu.parallel import MODEL_AXIS, dp_replicas, make_mesh
+from theanompi_tpu.parallel import tp as tp_lib
+
+
+def default_prefill_buckets(max_prefill: int, base: int = 16) -> tuple:
+    """Power-of-two bucket ladder ``base, 2*base, ...`` capped at
+    ``max_prefill`` (always included) — one compile per bucket."""
+    out = []
+    b = base
+    while b < max_prefill:
+        out.append(b)
+        b *= 2
+    out.append(max_prefill)
+    return tuple(out)
+
+
+class LlamaDecoder:
+    """KV-cache decoder over a compiled (and typically
+    checkpoint-restored) ``Llama`` — see module docstring.
+
+    The decoder owns the cache (``max_slots`` request slots of
+    ``max_seq`` positions each) and exposes the two host-callable
+    device functions the engine schedules:
+
+    - ``prefill(slot, prompt_ids, key, temperature) -> first token``
+    - ``decode(tokens, lengths, keys, temps) -> next tokens [S]``
+
+    Serving composes with tensor parallelism only: ``pp > 1``,
+    ``sp > 1`` and MoE models are not yet servable.
+    """
+
+    def __init__(
+        self,
+        model: Llama,
+        *,
+        max_slots: int = 8,
+        max_seq: int | None = None,
+        prefill_buckets: tuple | None = None,
+    ):
+        if model.mesh is None or model.params is None:
+            raise ValueError(
+                "LlamaDecoder needs a compiled model: call "
+                "build_model() + compile_iter_fns() (then load() for "
+                "checkpoint weights) before serving"
+            )
+        if model.pp > 1 or model.sp > 1 or model.n_experts:
+            raise NotImplementedError(
+                "serving composes with tensor parallelism only — "
+                f"pp={model.pp}, sp={model.sp}, "
+                f"n_experts={model.n_experts} are not yet servable"
+            )
+        self.model = model
+        self.mesh = model.mesh
+        self.max_slots = int(max_slots)
+        self.max_seq = int(max_seq or model.seq_len)
+        # decode appends one position past the prompt per token, so
+        # the longest servable prompt leaves room for >= 1 new token
+        self.max_prefill = self.max_seq - 1
+        self.prefill_buckets = tuple(
+            sorted(prefill_buckets)
+            if prefill_buckets else default_prefill_buckets(self.max_prefill)
+        )
+        assert self.prefill_buckets[-1] == self.max_prefill, (
+            f"largest prefill bucket {self.prefill_buckets[-1]} must "
+            f"equal max_prefill {self.max_prefill}"
+        )
+
+        m = model
+        self._h_loc = m.n_heads // m.tp
+        self._hkv_loc = m.n_kv_heads // m.tp
+        self._rep = self._h_loc // self._hkv_loc
+        self._hd = m.head_dim
+        self._cdtype = m.compute_dtype
+
+        # KV cache: one {k, v} pair per layer, [S, Hkv/tp, T, hd] in
+        # compute dtype, kv-head dim sharded over the model axis
+        kv_spec = P(None, MODEL_AXIS, None, None)
+        self._cache_specs = [
+            {"k": kv_spec, "v": kv_spec} for _ in range(m.n_layers)
+        ]
+        shape = (self.max_slots, m.n_kv_heads, self.max_seq, self._hd)
+        sharding = NamedSharding(self.mesh, kv_spec)
+
+        def _zeros():
+            z = jnp.zeros(shape, self._cdtype)
+            return [{"k": z, "v": z} for _ in range(m.n_layers)]
+
+        self.cache = jax.jit(
+            _zeros,
+            out_shardings=[
+                {"k": sharding, "v": sharding} for _ in range(m.n_layers)
+            ],
+        )()
+
+        # compiled variants: decode keyed by the static all-greedy
+        # flag, prefill by (bucket, greedy) — the compile count is
+        # bounded by 2 x (1 + bucket-ladder length)
+        self._decode_fns: dict[bool, object] = {}
+        self._prefill_fns: dict[tuple[int, bool], object] = {}
+
+    # -- device bodies (run on LOCAL shards inside shard_map) -------------
+
+    def _mlp(self, p, x):
+        xn = rms_norm(x, p["mlp_norm"])
+        gate = jax.nn.silu(tp_lib.col_parallel(xn, p["w_gate"]))
+        up = tp_lib.col_parallel(xn, p["w_up"])
+        return x + tp_lib.row_parallel(gate * up, p["w_down"]).astype(
+            x.dtype
+        )
+
+    def _sample(self, logits, keys, pos, temps, greedy: bool):
+        """Token ids from [N, V/tp] logits.  ``greedy=True`` is the
+        static all-greedy fast path: pure ``sharded_argmax``, no
+        Gumbel draw, no key fold — bitwise-identical ids to the
+        sampling path at temperature<=0 (both argmax the same f32
+        logits), so batch composition never changes outputs."""
+        if greedy:
+            return tp_lib.sharded_argmax(
+                logits.astype(jnp.float32), self.model.vocab
+            )
+        # the token that will sit at position pos+1 samples with
+        # fold_in(request_key, pos+1) — position-keyed, so batched
+        # and single-request decodes draw identical noise
+        skeys = jax.vmap(jax.random.fold_in)(keys, pos + 1)
+        return tp_lib.sharded_sample(
+            logits, self.model.vocab, skeys, temps
+        )
+
+    def _decode_body(self, params, cache, tokens, lengths, keys, temps,
+                     greedy: bool):
+        """One token for all slots.  tokens/lengths [S] int32, keys
+        [S, 2] uint32, temps [S] f32 -> (cache, next_tokens [S])."""
+        m = self.model
+        s = self.max_slots
+        hd, h_loc, hkv_loc, rep = (
+            self._hd, self._h_loc, self._hkv_loc, self._rep
+        )
+        x = tp_lib.embed_lookup(
+            tokens[:, None], params["embed"], m.vocab
+        )[:, 0, :].astype(self._cdtype)                       # [S, D]
+        pos = lengths                          # write position per slot
+        valid = (
+            jnp.arange(self.max_seq)[None, :] <= pos[:, None]
+        )[:, None, None, :]                            # [S, 1, 1, T]
+
+        new_cache = []
+        for layer_cache, p in zip(cache, params["layers"]):
+            xn = rms_norm(x, p["attn_norm"])
+            q = tp_lib.col_parallel(xn, p["wq"]).reshape(s, h_loc, hd)
+            k = tp_lib.col_parallel(xn, p["wk"]).reshape(s, hkv_loc, hd)
+            v = tp_lib.col_parallel(xn, p["wv"]).reshape(s, hkv_loc, hd)
+            q = rope_at(q, pos)
+            k = rope_at(k, pos)
+            # append this token's K/V at each slot's own position
+            write = jax.vmap(
+                lambda c, u, i: lax.dynamic_update_slice(
+                    c, u[:, None, :], (0, i, 0)
+                )
+            )
+            ck = write(layer_cache["k"], k.astype(self._cdtype), pos)
+            cv = write(layer_cache["v"], v.astype(self._cdtype), pos)
+            new_cache.append({"k": ck, "v": cv})
+            # GQA attention against the cached history: group the
+            # query heads by their KV head, no repeat materialized
+            qg = q.reshape(s, hkv_loc, rep, hd)
+            scores = jnp.einsum("skrd,sktd->skrt", qg, ck).astype(
+                jnp.float32
+            ) * (hd ** -0.5)
+            scores = jnp.where(valid, scores, NEG_INF)
+            probs = jax.nn.softmax(scores, axis=-1)
+            o = jnp.einsum(
+                "skrt,sktd->skrd", probs.astype(cv.dtype), cv
+            ).reshape(s, h_loc * hd)
+            x = x + tp_lib.row_parallel(o, p["wo"]).astype(self._cdtype)
+            x = self._mlp(p, x)
+
+        xf = rms_norm(x, params["final_norm"])
+        logits = tp_lib.col_parallel(xf, params["lm_head"])  # [S, V/tp]
+        nxt = self._sample(logits, keys, pos, temps, greedy)
+        return new_cache, nxt
+
+    def _prefill_body(self, params, cache, ids, slot, length, key, temp,
+                      greedy: bool):
+        """Prompt forward for ONE request: ids [t_bucket] int32
+        (zero-padded past ``length``), slot/length scalars.  Writes
+        K/V rows [0, t_bucket) of ``slot`` (rows >= length hold
+        padding garbage, but decode overwrites position p before any
+        token attends to it — positions are filled strictly in order)
+        and samples the first output token at position ``length``."""
+        m = self.model
+        hd, h_loc, hkv_loc, rep = (
+            self._hd, self._h_loc, self._hkv_loc, self._rep
+        )
+        t = ids.shape[0]
+        x = tp_lib.embed_lookup(
+            ids[None, :], params["embed"], m.vocab
+        ).astype(self._cdtype)                              # [1, t, D]
+        pos = jnp.arange(t)
+
+        new_cache = []
+        for layer_cache, p in zip(cache, params["layers"]):
+            xn = rms_norm(x, p["attn_norm"])
+            q = _heads(tp_lib.col_parallel(xn, p["wq"]), h_loc, hd)
+            k = _heads(tp_lib.col_parallel(xn, p["wk"]), hkv_loc, hd)
+            v = _heads(tp_lib.col_parallel(xn, p["wv"]), hkv_loc, hd)
+            q = rope(q, pos)
+            k = rope(k, pos)
+            kc = k.astype(self._cdtype)
+            vc = v.astype(self._cdtype)
+            new_cache.append({
+                "k": lax.dynamic_update_slice(
+                    layer_cache["k"], kc, (slot, 0, 0, 0)
+                ),
+                "v": lax.dynamic_update_slice(
+                    layer_cache["v"], vc, (slot, 0, 0, 0)
+                ),
+            })
+            if rep != 1:
+                k = jnp.repeat(k, rep, axis=1)
+                v = jnp.repeat(v, rep, axis=1)
+            o = flash_attention(q, k, v, causal=True)
+            x = x + tp_lib.row_parallel(
+                _unheads(o), p["wo"]
+            ).astype(self._cdtype)
+            x = self._mlp(p, x)
+
+        xf = rms_norm(x, params["final_norm"])
+        # only the LAST PROMPT TOKEN's logits matter — slice before
+        # the head so the [t, V] logits never materialize
+        x_last = lax.dynamic_slice(
+            xf, (0, length - 1, 0), (1, 1, xf.shape[-1])
+        )[:, 0, :]                                          # [1, D]
+        logits = tp_lib.col_parallel(x_last, params["lm_head"])
+        # the first generated token sits at position `length`:
+        # _sample folds pos+1, so pass length-1 (same fold policy as
+        # decode — token at position p always draws fold_in(key, p))
+        tok = self._sample(
+            logits, key[None], jnp.reshape(length - 1, (1,)),
+            temp[None], greedy,
+        )[0]
+        return new_cache, tok
+
+    # -- compiled entry points --------------------------------------------
+
+    def _decode_jit(self, greedy: bool):
+        fn = self._decode_fns.get(greedy)
+        if fn is None:
+            import functools
+
+            rep = P()
+            fn = jax.jit(
+                jax.shard_map(
+                    functools.partial(self._decode_body, greedy=greedy),
+                    mesh=self.mesh,
+                    in_specs=(self.model._specs, self._cache_specs,
+                              rep, rep, rep, rep),
+                    out_specs=(self._cache_specs, rep),
+                    check_vma=False,
+                ),
+                donate_argnums=(1,),
+            )
+            self._decode_fns[greedy] = fn
+        return fn
+
+    def _prefill_jit(self, bucket: int, greedy: bool):
+        fn = self._prefill_fns.get((bucket, greedy))
+        if fn is None:
+            import functools
+
+            rep = P()
+            fn = jax.jit(
+                jax.shard_map(
+                    functools.partial(
+                        self._prefill_body, greedy=greedy
+                    ),
+                    mesh=self.mesh,
+                    in_specs=(self.model._specs, self._cache_specs,
+                              rep, rep, rep, rep, rep),
+                    out_specs=(self._cache_specs, rep),
+                    check_vma=False,
+                ),
+                donate_argnums=(1,),
+            )
+            self._prefill_fns[(bucket, greedy)] = fn
+        return fn
+
+    def bucket_for(self, prompt_len: int) -> int:
+        """Smallest compiled-shape bucket covering ``prompt_len``."""
+        if not 1 <= prompt_len <= self.max_prefill:
+            raise ValueError(
+                f"prompt length {prompt_len} outside servable range "
+                f"[1, {self.max_prefill}] (max_seq {self.max_seq} "
+                f"leaves one position for generation)"
+            )
+        for b in self.prefill_buckets:
+            if b >= prompt_len:
+                return b
+        raise AssertionError("unreachable: last bucket == max_prefill")
+
+    # -- host API (the engine's two scheduling primitives) ----------------
+
+    def prefill(self, slot: int, prompt_ids, key, temperature) -> int:
+        """Run one prompt into ``slot``; returns the first sampled
+        token (host int — reading it IS the TTFT fence)."""
+        ids = np.asarray(prompt_ids, np.int32)
+        bucket = self.bucket_for(ids.shape[0])
+        padded = np.zeros((bucket,), np.int32)
+        padded[: ids.shape[0]] = ids
+        self.cache, tok = self._prefill_jit(bucket, temperature <= 0)(
+            self.model.params, self.cache,
+            jnp.asarray(padded),
+            jnp.int32(slot), jnp.int32(ids.shape[0]),
+            jnp.asarray(key, jnp.uint32),
+            jnp.float32(temperature),
+        )
+        return int(tok)
+
+    def decode(self, tokens, lengths, keys, temps) -> np.ndarray:
+        """One decode step for all slots.  Host arrays in, host token
+        ids [S] out (the read fences the step).  An all-greedy batch
+        (the common case) dispatches the Gumbel-free executable; a
+        mixed batch uses the sampling one, whose per-slot
+        temperature<=0 branch argmaxes identically."""
+        self.cache, nxt = self._decode_jit(
+            bool(np.all(np.asarray(temps) <= 0.0))
+        )(
+            self.model.params, self.cache,
+            jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(lengths, jnp.int32),
+            jnp.asarray(keys, jnp.uint32),
+            jnp.asarray(temps, jnp.float32),
+        )
+        return np.asarray(nxt)
+
+    @property
+    def n_prefill_compiles(self) -> int:
+        """Compiled prefill variants so far (bounded by 2 x the
+        bucket ladder: (bucket, greedy) keys — the compile-count
+        guarantee under test)."""
+        return len(self._prefill_fns)
+
+
+def decoder_from_checkpoint(
+    config: dict,
+    directory: str,
+    *,
+    mesh=None,
+    devices=None,
+    **decoder_kw,
+) -> LlamaDecoder:
+    """The train → checkpoint → serve path in one call: build a
+    ``Llama`` for the SERVING layout (``config['tp']`` etc.), restore
+    weights through ``model.load`` — including sharded checkpoints
+    and the validated/quarantine fallback path — and wrap it in a
+    ``LlamaDecoder``.  The checkpoint may come from any training
+    layout; npz and sharded formats both reload across layouts."""
+    model = Llama(config)
+    if mesh is None:
+        mesh = make_mesh(
+            data=1, model=model.tp,
+            devices=devices,
+        )
+    model.build_model(n_replicas=dp_replicas(mesh))
+    model.compile_iter_fns(mesh=mesh)
+    if not model.load(directory):
+        raise FileNotFoundError(
+            f"no loadable checkpoint under {directory!r}"
+        )
+    return LlamaDecoder(model, **decoder_kw)

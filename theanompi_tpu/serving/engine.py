@@ -1,0 +1,385 @@
+"""Engine layer: slot-based continuous batching over a
+``LlamaDecoder`` (Orca-style iteration-level scheduling).
+
+The decode batch is ``max_slots`` wide and never restarts: every
+engine iteration (1) sheds queued requests whose deadline passed,
+(2) refills any free slots from the queue — a prefill per admitted
+request, so a late-arriving request joins the NEXT decode step
+without disturbing the slots already in flight — and (3) runs ONE
+``decode_step`` for all active slots, evicting slots that hit EOS or
+``max_tokens``.  There is no stop-the-world batch boundary anywhere:
+requests enter and leave the batch per step.
+
+Admission control makes overload a RESULT, never a hang: a full
+queue sheds at ``submit`` time (status ``"shed"``, finish reason
+``"queue_full"``), and a queued request whose per-request deadline
+expires before a slot frees is shed on the next engine iteration
+(``"deadline"``).  Callers always get their future resolved.
+
+``submit()`` is thread-safe; the engine loop runs either inline
+(``run_until_idle`` — closed-loop benches) or on a background thread
+(``start``/``stop`` — open-loop traffic).  Telemetry (per-request
+TTFT/TPOT, aggregate tokens/s, slot occupancy, queue depth) flows
+through ``utils.recorder.ServingRecorder``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+import numpy as np
+
+import jax
+
+from theanompi_tpu.serving.decoder import LlamaDecoder
+from theanompi_tpu.utils.recorder import ServingRecorder
+
+
+@dataclass
+class Request:
+    """One generation request (all fields host-side)."""
+
+    prompt: list
+    max_tokens: int = 16
+    temperature: float = 0.0         # <= 0: greedy
+    deadline_s: float | None = None  # queue-wait budget from submit
+    seed: int = 0                    # per-request PRNG key seed
+
+
+@dataclass
+class Result:
+    """Terminal state of a request.  ``status``: ``"ok"`` (generated
+    until EOS/max_tokens) or ``"shed"`` (admission control refused
+    it; ``tokens`` is empty).  ``finish_reason``: ``"eos"``,
+    ``"max_tokens"``, ``"max_seq"`` when served; ``"queue_full"``,
+    ``"deadline"``, ``"prompt_too_long"``, ``"shutdown"`` when shed.
+    """
+
+    status: str
+    finish_reason: str
+    tokens: list = field(default_factory=list)
+    ttft_s: float | None = None   # submit -> first token
+    tpot_s: float | None = None   # mean inter-token time after first
+    queued_s: float | None = None
+    e2e_s: float | None = None
+
+
+class ServingFuture:
+    """Minimal thread-safe future for one request's ``Result``."""
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._result: Result | None = None
+
+    def _set(self, result: Result) -> None:
+        self._result = result
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: float | None = None) -> Result:
+        if not self._event.wait(timeout):
+            raise TimeoutError("serving result not ready")
+        return self._result
+
+
+class _Entry:
+    __slots__ = ("request", "future", "submit_t", "deadline_s")
+
+    def __init__(self, request: Request, default_deadline_s: float):
+        self.request = request
+        self.future = ServingFuture()
+        self.submit_t = time.monotonic()
+        # effective deadline lives on the entry — the caller's Request
+        # is never mutated
+        self.deadline_s = (
+            request.deadline_s if request.deadline_s is not None
+            else default_deadline_s
+        )
+
+
+class _SlotState:
+    __slots__ = (
+        "entry", "generated", "first_tok_t", "last_tok_t", "prompt_len",
+    )
+
+    def __init__(self, entry: _Entry, prompt_len: int, first_tok: int):
+        now = time.monotonic()
+        self.entry = entry
+        self.generated = [first_tok]
+        self.first_tok_t = now
+        self.last_tok_t = now
+        self.prompt_len = prompt_len
+
+
+class Engine:
+    """Thread-safe continuous-batching front-end over a decoder."""
+
+    def __init__(
+        self,
+        decoder: LlamaDecoder,
+        *,
+        queue_cap: int = 64,
+        default_deadline_s: float = 60.0,
+        eos_id: int | None = None,
+        recorder: ServingRecorder | None = None,
+    ):
+        self.decoder = decoder
+        self.queue_cap = int(queue_cap)
+        self.default_deadline_s = float(default_deadline_s)
+        self.eos_id = eos_id
+        s = decoder.max_slots
+        self.recorder = recorder or ServingRecorder(max_slots=s)
+
+        self._lock = threading.Lock()
+        self._queue: deque[_Entry] = deque()
+        self._slots: list[_SlotState | None] = [None] * s
+        # device-call mirrors (owned by the engine loop thread)
+        self._tokens = np.zeros((s,), np.int32)
+        self._lengths = np.zeros((s,), np.int32)
+        self._keys = np.zeros((s, 2), np.uint32)
+        self._temps = np.zeros((s,), np.float32)
+
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    # -- submission (any thread) ------------------------------------------
+
+    def submit(self, prompt, **kw) -> ServingFuture:
+        """Queue one request; returns its future.  A full queue, a
+        prompt the decoder cannot hold, or a stopping engine resolves
+        the future IMMEDIATELY with a shed result — the caller never
+        blocks on admission."""
+        if isinstance(prompt, Request):
+            if kw:
+                raise TypeError(
+                    f"submit(Request, ...) does not accept keyword "
+                    f"overrides {sorted(kw)} — set them on the "
+                    f"Request itself"
+                )
+            req = prompt
+        else:
+            req = Request(prompt=list(prompt), **kw)
+        entry = _Entry(req, self.default_deadline_s)
+        # servability check up front (admission, not an exception the
+        # engine loop would have to route back)
+        try:
+            self.decoder.bucket_for(len(req.prompt))
+        except ValueError:
+            entry.future._set(Result(
+                status="shed", finish_reason="prompt_too_long",
+                queued_s=0.0,
+            ))
+            self.recorder.record_request(
+                status="shed", finish_reason="prompt_too_long",
+                n_prompt=len(req.prompt), n_generated=0,
+            )
+            return entry.future
+        with self._lock:
+            # the shutdown check shares the enqueue's lock hold: an
+            # entry appended here with _stop unset is guaranteed
+            # visible to the final drain's (also locked) queue-depth
+            # probe, so it drains; with _stop set it sheds — either
+            # way every future resolves and stop() terminates even
+            # with producers still submitting
+            reason = (
+                "shutdown" if self._stop.is_set()
+                else "queue_full"
+                if len(self._queue) >= self.queue_cap else None
+            )
+            if reason is None:
+                self._queue.append(entry)
+        if reason is not None:
+            entry.future._set(Result(
+                status="shed", finish_reason=reason, queued_s=0.0,
+            ))
+            self.recorder.record_request(
+                status="shed", finish_reason=reason,
+                n_prompt=len(req.prompt), n_generated=0,
+            )
+        return entry.future
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def active_slots(self) -> int:
+        return sum(s is not None for s in self._slots)
+
+    # -- engine loop (one owner thread) -----------------------------------
+
+    def _shed(self, entry: _Entry, reason: str, now: float) -> None:
+        entry.future._set(Result(
+            status="shed", finish_reason=reason,
+            queued_s=now - entry.submit_t,
+        ))
+        self.recorder.record_request(
+            status="shed", finish_reason=reason,
+            n_prompt=len(entry.request.prompt), n_generated=0,
+            queued_s=now - entry.submit_t,
+        )
+
+    def _sweep_deadlines(self, now: float) -> None:
+        """Shed every queued request past its deadline — overload
+        turns into load-shed results while the decode loop keeps
+        serving the admitted batch."""
+        with self._lock:
+            keep: deque[_Entry] = deque()
+            expired = []
+            for entry in self._queue:
+                if now - entry.submit_t > entry.deadline_s:
+                    expired.append(entry)
+                else:
+                    keep.append(entry)
+            self._queue = keep
+        for entry in expired:
+            self._shed(entry, "deadline", now)
+
+    def _finish(self, slot: int, reason: str) -> None:
+        st = self._slots[slot]
+        self._slots[slot] = None
+        # reset the device-call mirrors: a stale temperature>0 would
+        # force the Gumbel sampling executable on later all-greedy
+        # batches (outputs would stay bitwise-correct, but the fast
+        # path would be silently defeated)
+        self._temps[slot] = 0.0
+        self._tokens[slot] = 0
+        self._lengths[slot] = 0
+        n = len(st.generated)
+        tpot = (
+            (st.last_tok_t - st.first_tok_t) / (n - 1) if n > 1 else None
+        )
+        e2e = st.last_tok_t - st.entry.submit_t
+        ttft = st.first_tok_t - st.entry.submit_t
+        res = Result(
+            status="ok", finish_reason=reason,
+            tokens=list(st.generated),
+            ttft_s=ttft, tpot_s=tpot,
+            queued_s=None, e2e_s=e2e,
+        )
+        st.entry.future._set(res)
+        self.recorder.record_request(
+            status="ok", finish_reason=reason,
+            n_prompt=st.prompt_len, n_generated=n,
+            ttft_s=ttft, tpot_s=tpot, e2e_s=e2e,
+        )
+
+    def _admit(self, now: float) -> None:
+        """Fill free slots from the queue head — a prefill each, so
+        the admitted request rides the very next decode step."""
+        for slot in range(self.decoder.max_slots):
+            if self._slots[slot] is not None:
+                continue
+            with self._lock:
+                entry = self._queue.popleft() if self._queue else None
+            if entry is None:
+                return
+            req = entry.request
+            key = np.asarray(
+                jax.random.PRNGKey(req.seed), np.uint32
+            )
+            first = self.decoder.prefill(
+                slot, req.prompt, key, req.temperature
+            )
+            self._slots[slot] = _SlotState(entry, len(req.prompt), first)
+            self._tokens[slot] = first
+            self._lengths[slot] = len(req.prompt)
+            self._keys[slot] = key
+            self._temps[slot] = req.temperature
+            if self.eos_id is not None and first == self.eos_id:
+                self._finish(slot, "eos")
+            elif req.max_tokens <= 1:
+                self._finish(slot, "max_tokens")
+
+    def _decode_once(self) -> int:
+        nxt = self.decoder.decode(
+            self._tokens, self._lengths, self._keys, self._temps
+        )
+        now = time.monotonic()
+        emitted = 0
+        for slot, st in enumerate(self._slots):
+            if st is None:
+                continue
+            self._lengths[slot] += 1  # last token now lives in cache
+            tok = int(nxt[slot])
+            self._tokens[slot] = tok
+            st.generated.append(tok)
+            st.last_tok_t = now
+            emitted += 1
+            req = st.entry.request
+            if self.eos_id is not None and tok == self.eos_id:
+                self._finish(slot, "eos")
+            elif len(st.generated) >= req.max_tokens:
+                self._finish(slot, "max_tokens")
+            elif self._lengths[slot] >= self.decoder.max_seq:
+                # the NEXT write position (== lengths) is out of
+                # cache bounds — the last row was used this step
+                self._finish(slot, "max_seq")
+        return emitted
+
+    def step(self) -> bool:
+        """One engine iteration (shed → admit → decode).  Returns
+        whether any device work ran — the loop's idle signal."""
+        now = time.monotonic()
+        self._sweep_deadlines(now)
+        self._admit(now)
+        if not any(s is not None for s in self._slots):
+            return False
+        t0 = time.monotonic()
+        emitted = self._decode_once()
+        self.recorder.record_step(
+            active_slots=emitted,  # the batch that actually decoded
+            queue_depth=self.queue_depth(),
+            dt_s=time.monotonic() - t0,
+            tokens=emitted,
+        )
+        return True
+
+    def run_until_idle(self) -> None:
+        """Drive the loop inline until no request is queued or in
+        flight (closed-loop mode: callers pre-submit, then drain)."""
+        while True:
+            did = self.step()
+            if not did and self.queue_depth() == 0:
+                return
+
+    def start(self) -> None:
+        """Background-thread mode for open-loop traffic: the loop
+        idles at ~1 ms granularity waiting for submissions."""
+        if self._thread is not None:
+            raise RuntimeError("engine already started")
+        self._stop.clear()
+
+        def _loop():
+            while not self._stop.is_set():
+                if not self.step() and self.queue_depth() == 0:
+                    time.sleep(1e-3)
+            # drain what was admitted/queued before stop()
+            self.run_until_idle()
+
+        self._thread = threading.Thread(
+            target=_loop, name="tm-serving-engine", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        """Stop the background loop, draining work submitted BEFORE
+        the stop (later submissions shed with reason "shutdown", so
+        the drain — and therefore stop() — always terminates)."""
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join()
+        self._thread = None
+        # belt-and-braces: any entry that slipped in around the final
+        # drain still resolves (the "never a hang" contract)
+        now = time.monotonic()
+        with self._lock:
+            residual = list(self._queue)
+            self._queue.clear()
+        for entry in residual:
+            self._shed(entry, "shutdown", now)

@@ -277,3 +277,117 @@ class Recorder:
 
     def load(self, path: str | Path) -> None:
         self.load_state_dict(json.loads(Path(path).read_text()))
+
+
+# ---------------------------------------------------------------------------
+# serving telemetry (theanompi_tpu/serving)
+# ---------------------------------------------------------------------------
+
+
+def _percentile(xs: list[float], q: float) -> float | None:
+    """p-th percentile or None on empty input (shed-only runs must
+    not crash the summary)."""
+    return float(np.percentile(np.asarray(xs, np.float64), q)) if xs \
+        else None
+
+
+class ServingRecorder:
+    """Telemetry sink for the continuous-batching engine: per-request
+    TTFT/TPOT, aggregate tokens/s over decode time, slot occupancy,
+    and queue depth.  The training ``Recorder`` measures a step loop;
+    this measures a request loop — separate class, same module, so
+    every wall-clock datum in the repo lives in one place.
+
+    Per-request fields (``record_request``): ``status`` "ok"/"shed",
+    ``finish_reason``, prompt/generated token counts, ``ttft_s``
+    (submit → first token), ``tpot_s`` (mean inter-token seconds
+    after the first), ``queued_s``, ``e2e_s``.
+
+    Per-step fields (``record_step``): slots that decoded, queue
+    depth at the step, step seconds, tokens emitted.
+    """
+
+    def __init__(self, max_slots: int = 1):
+        self.max_slots = int(max_slots)
+        self.requests: list[dict] = []
+        self.steps: list[dict] = []
+
+    def record_request(
+        self,
+        *,
+        status: str,
+        finish_reason: str,
+        n_prompt: int,
+        n_generated: int,
+        ttft_s: float | None = None,
+        tpot_s: float | None = None,
+        queued_s: float | None = None,
+        e2e_s: float | None = None,
+    ) -> None:
+        self.requests.append({
+            "status": status,
+            "finish_reason": finish_reason,
+            "n_prompt": int(n_prompt),
+            "n_generated": int(n_generated),
+            "ttft_s": ttft_s,
+            "tpot_s": tpot_s,
+            "queued_s": queued_s,
+            "e2e_s": e2e_s,
+        })
+
+    def record_step(
+        self,
+        *,
+        active_slots: int,
+        queue_depth: int,
+        dt_s: float,
+        tokens: int,
+    ) -> None:
+        self.steps.append({
+            "active_slots": int(active_slots),
+            "queue_depth": int(queue_depth),
+            "dt_s": float(dt_s),
+            "tokens": int(tokens),
+        })
+
+    def summary(self) -> dict:
+        """One dict the bench row emits: throughput, latency
+        percentiles, occupancy, queue pressure, shed accounting."""
+        ok = [r for r in self.requests if r["status"] == "ok"]
+        shed = [r for r in self.requests if r["status"] == "shed"]
+        ttfts = [r["ttft_s"] for r in ok if r["ttft_s"] is not None]
+        tpots = [r["tpot_s"] for r in ok if r["tpot_s"] is not None]
+        decode_s = sum(s["dt_s"] for s in self.steps)
+        tokens = sum(s["tokens"] for s in self.steps)
+        occ = (
+            sum(s["active_slots"] * s["dt_s"] for s in self.steps)
+            / (self.max_slots * decode_s)
+            if decode_s else None
+        )
+        depths = [s["queue_depth"] for s in self.steps]
+        shed_reasons: dict[str, int] = {}
+        for r in shed:
+            shed_reasons[r["finish_reason"]] = (
+                shed_reasons.get(r["finish_reason"], 0) + 1
+            )
+        return {
+            "n_requests": len(self.requests),
+            "n_completed": len(ok),
+            "n_shed": len(shed),
+            "shed_reasons": shed_reasons,
+            "tokens_generated": tokens,   # decode-step tokens only
+            # all tokens delivered to completed requests (includes
+            # each request's prefill-sampled first token)
+            "tokens_completed": sum(r["n_generated"] for r in ok),
+            "decode_s": decode_s,
+            "tokens_per_sec": tokens / decode_s if decode_s else None,
+            "ttft_p50_s": _percentile(ttfts, 50),
+            "ttft_p95_s": _percentile(ttfts, 95),
+            "tpot_p50_s": _percentile(tpots, 50),
+            "tpot_p95_s": _percentile(tpots, 95),
+            "slot_occupancy": occ,
+            "queue_depth_mean": (
+                float(np.mean(depths)) if depths else None
+            ),
+            "queue_depth_max": max(depths) if depths else None,
+        }

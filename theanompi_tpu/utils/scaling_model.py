@@ -456,6 +456,66 @@ def llama_max_batch(
     return lo
 
 
+def llama_kv_bytes_per_token(cfg: dict, *, kv_dtype_bytes: int = 2) -> int:
+    """Bytes ONE cached token occupies (K + V, all layers, compact
+    GQA heads — the serving cache layout, serving/decoder.py)."""
+    hd = int(cfg["dim"]) // int(cfg["n_heads"])
+    return (
+        2 * int(cfg["n_layers"]) * int(cfg["n_kv_heads"]) * hd
+        * kv_dtype_bytes
+    )
+
+
+def serving_roofline(
+    cfg: dict,
+    *,
+    batch: int,
+    context: int,
+    tp: int = 1,
+    param_dtype_bytes: int = 2,
+    kv_dtype_bytes: int = 2,
+    chip: ChipSpec = V5E,
+) -> dict:
+    """HBM-bandwidth roofline for the serving DECODE step.
+
+    Generating one token per slot is matmul-starved: every weight
+    matrix is read ONCE per step (amortized over the whole batch)
+    and each slot additionally reads its own KV history — at batch 1
+    the step moves ~all parameter bytes to produce ONE token, so
+    decode is bound by HBM bandwidth, not FLOPs (the opposite regime
+    from training, where ``llama_step_flops`` vs peak MFU governs).
+
+        t_step   = (param_bytes/tp + batch * kv_context_bytes/tp)
+                   / hbm_bw
+        tokens/s = batch / t_step
+
+    ``crossover_batch`` is where the batch's KV reads equal the
+    weight reads — past it, adding slots stops being ~free and
+    tokens/s per slot degrades toward the KV-bandwidth bound.  The
+    bench row's measured tokens/s at each offered load is the
+    CPU-mesh analogue of this curve; on real v5e the prediction is
+    checkable against the datasheet 819 GB/s.
+    """
+    p_bytes = llama_param_count(cfg) * param_dtype_bytes / tp
+    kv_tok = llama_kv_bytes_per_token(
+        cfg, kv_dtype_bytes=kv_dtype_bytes
+    ) / tp
+    kv_slot = kv_tok * context
+    bytes_per_step = p_bytes + batch * kv_slot
+    t_step = bytes_per_step / chip.hbm_bw
+    return {
+        "param_bytes_per_chip": p_bytes,
+        "kv_bytes_per_slot": kv_slot,
+        "bytes_per_step": bytes_per_step,
+        "bytes_per_token": bytes_per_step / batch,
+        "step_ms": t_step * 1e3,
+        "tokens_per_sec": batch / t_step,
+        "tokens_per_sec_per_slot": 1.0 / t_step,
+        "param_read_frac": p_bytes / bytes_per_step,
+        "crossover_batch": p_bytes / kv_slot if kv_slot else None,
+    }
+
+
 def llama_step_flops(cfg: dict, batch: int, seq_len: int | None = None,
                      remat: bool = True) -> float:
     """Training FLOPs per step: 6*P*tokens for the matmuls (fwd 2PT +
