@@ -1237,6 +1237,302 @@ def bench_serving() -> dict:
     }
 
 
+_SERVING_PAGED_CHILD = r"""
+import json, os, sys, tempfile, time
+sys.path.insert(0, os.environ["TM_REPO"])
+import jax
+jax.config.update("jax_default_device", jax.devices("cpu")[0])
+import numpy as np
+from theanompi_tpu.models.llama import Llama
+from theanompi_tpu.parallel import make_mesh
+from theanompi_tpu.serving import Engine, decoder_from_checkpoint
+from theanompi_tpu.utils import Recorder, ServingRecorder
+from theanompi_tpu.utils import trace_comm
+
+smoke = os.environ.get("TM_SERVING_SMOKE") == "1"
+devs = jax.devices("cpu")[:8]
+cfg = dict(dim=128, n_layers=2, n_heads=8, n_kv_heads=8, ffn_dim=352,
+           vocab=2048, seq_len=256, batch_size=2, lr=1e-3, seed=11,
+           compute_dtype="float32")
+# the artifact under serve is a REAL training checkpoint (same
+# protocol as the v1 serving row): short dp=8 run, model.save
+m = Llama(cfg); m.build_model(n_replicas=8)
+m.compile_iter_fns(mesh=make_mesh(data=8, devices=devs))
+rec = Recorder(verbose=False)
+for i in range(2):
+    m.train_iter(i, rec)
+rec.flush()
+td = tempfile.mkdtemp(); m.save(td)
+
+MAX_SEQ, BS = 128, 16
+# n_blocks deliberately BELOW full provisioning (8 slots x 8 blocks):
+# paged admission succeeds because requests hold only what they use
+dec_pg = decoder_from_checkpoint(
+    dict(cfg, tp=8), td, devices=devs, paged=True, max_slots=8,
+    max_seq=MAX_SEQ, block_size=BS, n_blocks=48, prefill_chunk=32)
+dec_v1 = None if smoke else decoder_from_checkpoint(
+    dict(cfg, tp=8), td, devices=devs, max_slots=8, max_seq=MAX_SEQ)
+
+SYS = [7, 3, 11, 5] * 10          # 40-token shared system prompt
+rng = np.random.default_rng(0)
+def shared_prompts(n):
+    return [SYS + [int(t) for t in rng.integers(1, cfg["vocab"], 6)]
+            for _ in range(n)]
+def distinct_prompts(n):
+    return [[int(t) for t in
+             rng.integers(1, cfg["vocab"], int(rng.integers(8, 40)))]
+            for _ in range(n)]
+
+max_tokens = 8 if smoke else 16
+# allocator/radix counters live on the SHARED decoder, so each arm
+# reports its own delta (gauges stay point-in-time; the in-use
+# high-water mark restarts from the current occupancy)
+PAGING_COUNTERS = {"n_allocs", "n_frees", "n_cow", "n_oom",
+                   "n_lookups", "n_hits", "matched_tokens",
+                   "inserted_blocks", "evicted_blocks"}
+def run_arm(dec, prompts, **ekw):
+    eng = Engine(dec, recorder=ServingRecorder(dec.max_slots), **ekw)
+    before = eng.paging_stats()
+    if before is not None:
+        alloc = dec.manager.allocator
+        alloc.peak_in_use = alloc.blocks_in_use
+    t0 = time.perf_counter()
+    futs = [eng.submit(p, max_tokens=max_tokens, seed=i)
+            for i, p in enumerate(prompts)]
+    eng.run_until_idle()
+    wall = time.perf_counter() - t0
+    assert all(f.done() for f in futs)     # served, never hung
+    rs = [f.result(timeout=0) for f in futs]
+    s = eng.recorder.summary()
+    s["wall_s"] = wall
+    s["offered"] = len(prompts)
+    s["all_ok"] = all(r.status == "ok" for r in rs)
+    ps = eng.paging_stats()
+    if ps is not None:
+        s["paging"] = {
+            grp: {k: v - before.get(grp, {}).get(k, 0)
+                  if k in PAGING_COUNTERS else v
+                  for k, v in vals.items()}
+            for grp, vals in ps.items()}
+    return s
+
+# warm every executable OUTSIDE the timed arms — for v1 that means
+# every prefill BUCKET the arm prompts will hit (8-46 tokens →
+# buckets 16/32/64), or its TTFT would be measuring XLA compiles
+for d in ([dec_pg] if dec_v1 is None else [dec_pg, dec_v1]):
+    warm = Engine(d, recorder=ServingRecorder(d.max_slots))
+    for n in (8, 20, 50):
+        warm.submit([2] * n, max_tokens=2)
+    warm.run_until_idle()
+if dec_pg.prefix_cache is not None:
+    dec_pg.prefix_cache.clear()
+
+def prime_cache():
+    # concurrent identical arrivals all admit before the first
+    # insert lands (they match at ADMISSION time), so the warm arm
+    # models steady state: the system prompt entered the radix cache
+    # via earlier traffic — one primer request
+    prime = Engine(dec_pg, recorder=ServingRecorder(dec_pg.max_slots))
+    prime.submit(SYS + [1], max_tokens=2)
+    prime.run_until_idle()
+
+out = {"block_size": BS, "n_blocks": dec_pg.manager.allocator.n_blocks,
+       "max_seq": MAX_SEQ,
+       "kv_bytes_per_block": dec_pg.kv_bytes_per_block()}
+if not smoke:
+    out["hbm_per_slot_contiguous"] = dec_v1.kv_bytes_per_slot()
+    out["arms"] = arms = {}
+    # A/B: paged vs slot-contiguous, with/without the shared prefix
+    arms["contiguous_distinct"] = run_arm(dec_v1, distinct_prompts(8))
+    arms["contiguous_shared"] = run_arm(dec_v1, shared_prompts(8))
+    # prefix_caching OFF: with inserts on, finished requests' blocks
+    # stay cache-retained, so blocks_in_use_max would count dead
+    # requests and inflate the HBM-per-active-request figure
+    arms["paged_distinct"] = run_arm(
+        dec_pg, distinct_prompts(8), prefix_caching=False)
+    dec_pg.prefix_cache.clear()
+    arms["paged_shared_cold"] = run_arm(
+        dec_pg, shared_prompts(8), prefix_caching=False)
+    prime_cache()
+    arms["paged_shared_warm"] = run_arm(dec_pg, shared_prompts(8))
+else:
+    prime_cache()
+    out["arms"] = arms = {
+        "paged_shared_warm": run_arm(dec_pg, shared_prompts(4))}
+
+warm_arm = arms["paged_shared_warm"]
+assert warm_arm["all_ok"] and warm_arm["n_shed"] == 0, warm_arm
+assert warm_arm["prefix_hit_rate"] and warm_arm["prefix_hit_rate"] > 0, \
+    "shared-prefix arm saw no prefix-cache hits"
+# token accounting: every request got exactly max_tokens
+assert warm_arm["tokens_completed"] == warm_arm["offered"] * max_tokens, \
+    (warm_arm["tokens_completed"], warm_arm["offered"], max_tokens)
+# one-compile discipline survives the whole sweep
+out["n_decode_compiles"] = dec_pg.n_decode_compiles
+out["n_prefill_compiles"] = dec_pg.n_prefill_compiles
+assert dec_pg.n_decode_compiles <= 2, dec_pg.n_decode_compiles
+assert dec_pg.n_prefill_compiles <= 2, dec_pg.n_prefill_compiles
+
+if not smoke:
+    # sampler / paged-attention cost attribution (PR 4's named-scope
+    # technique): instruction names from the decode executable's
+    # optimized HLO, summed out of a profiler trace of a decode run
+    hlo = dec_pg.decode_hlo_text()   # ONE AOT compile for both scans
+    ops_sample = trace_comm.scope_op_names(hlo, markers=("serving_sample",))
+    ops_attend = trace_comm.scope_op_names(hlo, markers=("paged_attend",))
+    # instruction names are module-unique, NOT trace-unique: prefill
+    # has its own serving_sample ops and its own fusion.N, so a trace
+    # that interleaved it with decode would attribute prefill events
+    # to these sets.  The traced window therefore covers ONLY pure
+    # decode: admit + prefill (and, with caching off, every possible
+    # CoW) run before the capture starts
+    eng_t = Engine(dec_pg, recorder=ServingRecorder(dec_pg.max_slots),
+                   prefix_caching=False)
+    futs_t = [eng_t.submit(p, max_tokens=max_tokens, seed=i)
+              for i, p in enumerate(distinct_prompts(8))]
+    eng_t.step()    # submit only enqueues: admission happens here
+    while eng_t.n_prefilling():
+        eng_t.step()
+    with tempfile.TemporaryDirectory() as tdir:
+        trace_comm.capture_trace(eng_t.run_until_idle, tdir)
+        rep_s = trace_comm.comm_report(tdir, quant_ops=ops_sample)
+        rep_a = trace_comm.comm_report(tdir, quant_ops=ops_attend)
+    assert all(f.result(timeout=0).status == "ok" for f in futs_t)
+    out["decode_attribution"] = {
+        "sampler_frac": rep_s["quant_frac"],
+        "paged_attend_frac": rep_a["quant_frac"],
+        "n_sampler_ops": len(ops_sample),
+        "n_attend_ops": len(ops_attend),
+    }
+print("SERVING_PAGED " + json.dumps(out))
+"""
+
+
+def bench_serving_paged() -> dict:
+    """Paged KV-cache serving A/B row (ISSUE 6): the v2 paged
+    decoder (block tables + radix prefix cache + chunked prefill)
+    against the v1 slot-contiguous decoder, same training
+    checkpoint, same 8-dev CPU mesh — with and without a shared
+    40-token system prompt.
+
+    The judged claims: (1) HBM per active request drops vs
+    slot-contiguous at equal ``max_seq`` (blocks held ∝ tokens
+    used); (2) the shared-prefix arm's TTFT improves once the radix
+    cache is warm, with the hit rate reported; (3) the decode
+    executable NEVER recompiles across the sweep
+    (``n_decode_compiles`` asserted in-child); (4) sampler vs
+    paged-attention decode cost is attributed from the trace via
+    named scopes (the next decode-speed lever ROADMAP item 4
+    names)."""
+    import os
+    import subprocess
+    import sys
+
+    from theanompi_tpu.models.llama import LLAMA3_8B
+    from theanompi_tpu.utils import scaling_model as sm
+
+    env = dict(os.environ)
+    env.update(
+        TM_REPO=str(REPO),
+        TM_TPU_PLATFORM="cpu",
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+        PALLAS_AXON_POOL_IPS="",
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", _SERVING_PAGED_CHILD],
+        env=env, capture_output=True, text=True, timeout=2400,
+    )
+    rec = None
+    for line in out.stdout.splitlines():
+        if line.startswith("SERVING_PAGED "):
+            rec = json.loads(line[len("SERVING_PAGED "):])
+    if rec is None:
+        raise RuntimeError(
+            f"serving_paged child produced no result:\n"
+            f"{out.stdout[-1500:]}\n{out.stderr[-1500:]}"
+        )
+
+    arms = rec["arms"]
+    warm = arms["paged_shared_warm"]
+    result = {
+        "metric": (
+            "paged KV-cache Llama serving tokens/sec (block-table "
+            "attention + radix prefix cache + chunked prefill, "
+            "128d proxy ckpt, tp=8, 8 slots, 8-dev CPU mesh)"
+        ),
+        "value": round(warm["tokens_per_sec"], 2),
+        "unit": "tokens/sec",
+        "vs_baseline": None,
+        "prefix_hit_rate": round(warm["prefix_hit_rate"], 4),
+        "n_decode_compiles": rec["n_decode_compiles"],
+        "n_prefill_compiles": rec["n_prefill_compiles"],
+        "block_size": rec["block_size"],
+        "n_blocks": rec["n_blocks"],
+    }
+
+    def rounded(s):
+        return {
+            k: (round(v, 4) if isinstance(v, float) else v)
+            for k, v in s.items() if k != "paging"
+        } | ({"paging": s["paging"]} if "paging" in s else {})
+
+    result["arms"] = {name: rounded(s) for name, s in arms.items()}
+    if "paged_shared_cold" in arms:
+        cold, contig = arms["paged_shared_cold"], arms[
+            "contiguous_shared"
+        ]
+        result["ttft_p50_warm_vs_cold"] = {
+            "cold_s": round(cold["ttft_p50_s"], 4),
+            "warm_s": round(warm["ttft_p50_s"], 4),
+            "speedup": round(
+                cold["ttft_p50_s"] / warm["ttft_p50_s"], 3
+            ),
+            "contiguous_s": round(contig["ttft_p50_s"], 4),
+        }
+        # HBM per active request: measured peak blocks over the
+        # distinct-prompt arm vs the contiguous layout's fixed
+        # max_seq rows per slot
+        pd = arms["paged_distinct"]
+        n_active = min(pd["offered"], 8)
+        paged_per_req = (
+            pd["blocks_in_use_max"] * rec["kv_bytes_per_block"]
+            / n_active
+        )
+        result["hbm_per_active_request"] = {
+            "paged_bytes": round(paged_per_req),
+            "contiguous_bytes": rec["hbm_per_slot_contiguous"],
+            "saving": round(
+                rec["hbm_per_slot_contiguous"] / paged_per_req, 2
+            ),
+        }
+    if "decode_attribution" in rec:
+        result["decode_attribution"] = {
+            k: (round(v, 4) if isinstance(v, float) else v)
+            for k, v in rec["decode_attribution"].items()
+        }
+    result["predicted_v5e_8b_tp8_paged"] = {
+        k: (round(v, 4) if isinstance(v, float) else v)
+        for k, v in sm.serving_roofline(
+            LLAMA3_8B, batch=8, context=1024, tp=8,
+            max_seq=8192, block_size=16, prefix_hit_frac=0.9,
+        ).items()
+        if k in ("paged_kv_bytes_per_slot",
+                 "contiguous_kv_bytes_per_slot", "paged_hbm_saving",
+                 "max_slots_paged", "max_slots_contiguous",
+                 "prefix_ttft_speedup", "tokens_per_sec")
+    }
+    result["scale_note"] = (
+        "XLA:CPU mesh decode — absolute tokens/s is CPU-bound; the "
+        "paged mechanics (block-table gather/scatter, CoW, radix "
+        "adoption, chunked prefill, no-recompile sweep) are "
+        "platform-independent and predicted_v5e_8b_tp8_paged is the "
+        "datasheet capacity/TTFT model the real chip is checked "
+        "against"
+    )
+    return result
+
+
 def bench_easgd() -> dict:
     """BASELINE config 3: WRN-28-10 under the EASGD rule's exchange
     cadence, on the real chip — the async rules' first captured COST
@@ -1592,11 +1888,37 @@ BENCHES = {
     "bucketed": lambda **kw: bench_bucketed(),
     "compressed": lambda **kw: bench_compressed(),
     "serving": lambda **kw: bench_serving(),
+    "serving_paged": lambda **kw: bench_serving_paged(),
     "loader": lambda **kw: bench_loader(),
     "loader_train": lambda **kw: bench_loader_train(),
     "easgd": lambda **kw: bench_easgd(),
     "gosgd": lambda **kw: bench_gosgd(),
 }
+
+
+def _headline_line(rec: dict) -> str:
+    """Truncation-proof summary (ROADMAP item 4c): the full record is
+    one LARGE JSON line, and driver artifacts keep the TAIL of the
+    output — so a head-truncated capture loses the line start and
+    with it the whole record.  This compact single line is printed
+    LAST: whatever else is cut, the judged numbers survive.  One
+    number + vs_baseline per bench; secondary errors collapse to a
+    short string."""
+    compact = {
+        k: rec.get(k) for k in ("metric", "value", "unit", "vs_baseline")
+    }
+    sec = rec.get("secondary")
+    if sec:
+        compact["secondary"] = {
+            name: (
+                {"value": row.get("value"),
+                 "vs_baseline": row.get("vs_baseline")}
+                if "error" not in row else
+                {"error": str(row["error"])[:120]}
+            )
+            for name, row in sec.items()
+        }
+    return "BENCH_HEADLINE " + json.dumps(compact)
 
 
 def main() -> None:
@@ -1610,7 +1932,9 @@ def main() -> None:
         # flagship (the pre-r3 behavior) so a driver always gets its
         # one JSON line
         bench = BENCHES.get(which, BENCHES["resnet50"])
-        print(json.dumps(bench()))
+        rec = bench()
+        print(json.dumps(rec))
+        print(_headline_line(rec))
         return
 
     # default (what the driver runs): EVERY flagship in one JSON line.
@@ -1623,8 +1947,8 @@ def main() -> None:
     rec = BENCHES["resnet50"]()
     secondary = {}
     for name in ("wresnet", "llama", "alexnet", "zero1", "bucketed",
-                 "compressed", "serving", "loader", "loader_train",
-                 "easgd", "gosgd"):
+                 "compressed", "serving", "serving_paged", "loader",
+                 "loader_train", "easgd", "gosgd"):
         # two attempts: the tunneled remote-compile service drops a
         # response now and then (observed: "response body closed
         # before all bytes were read"); a transient must not cost the
@@ -1646,6 +1970,7 @@ def main() -> None:
         gc.collect()  # drop the previous model's HBM dataset cache
     rec["secondary"] = secondary
     print(json.dumps(rec))
+    print(_headline_line(rec))
 
 
 if __name__ == "__main__":

@@ -12,6 +12,11 @@
 #    concurrent prompts through the continuous batcher at 8 tokens
 #    each off a just-saved training checkpoint; asserts every
 #    request completes (none shed, none hung) and tokens flowed.
+# 3. serving_paged: the v2 paged-KV row in smoke shape — 4 requests
+#    sharing a 40-token system prompt against a primed radix cache;
+#    asserts prefix hit rate > 0, every request completes, token
+#    accounting is exact, and the decode executable never recompiled
+#    (the in-child compile-counter assertions also gate this).
 #
 # Usage: bash scripts/bench_smoke.sh
 
@@ -49,4 +54,24 @@ if arm["n_completed"] != 4 or arm["n_shed"] != 0:
 if not (arm["tokens_completed"] == 4 * 8 and arm["tokens_per_sec"] > 0):
     sys.exit("bench_smoke: serving arm token accounting off: %s" % arm)
 print("bench_smoke: serving OK")
+'
+
+out=$(TM_SERVING_SMOKE=1 TM_BENCH_MODEL=serving_paged python bench.py)
+printf '%s\n' "$out" | python -c '
+import json, sys
+row = json.loads(sys.stdin.readline())
+arm = row["arms"]["paged_shared_warm"]
+print("paged tokens/s", arm.get("tokens_per_sec"),
+      "prefix hit rate", row.get("prefix_hit_rate"),
+      "decode compiles", row.get("n_decode_compiles"))
+if not (row.get("prefix_hit_rate") or 0) > 0:
+    sys.exit("bench_smoke: shared-prefix arm saw no radix hits: %s" % row)
+if arm["n_completed"] != 4 or arm["n_shed"] != 0 or not arm["all_ok"]:
+    sys.exit("bench_smoke: paged arm did not complete all 4 "
+             "requests: %s" % arm)
+if arm["tokens_completed"] != 4 * 8:
+    sys.exit("bench_smoke: paged arm token accounting off: %s" % arm)
+if row["n_decode_compiles"] > 2 or row["n_prefill_compiles"] > 2:
+    sys.exit("bench_smoke: paged executables recompiled: %s" % row)
+print("bench_smoke: serving_paged OK")
 '

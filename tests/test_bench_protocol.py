@@ -9,7 +9,11 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-from bench import _env_cfg_overrides, _window_stats  # noqa: E402
+from bench import (  # noqa: E402
+    _env_cfg_overrides,
+    _headline_line,
+    _window_stats,
+)
 
 
 class TestWindowStats:
@@ -56,3 +60,48 @@ class TestEnvCfgOverrides:
         monkeypatch.setenv("TM_BENCH_CFG", "{not json")
         with pytest.raises(json.JSONDecodeError):
             _env_cfg_overrides()
+
+
+class TestHeadlineLine:
+    """ROADMAP item 4c: the LAST line of bench output is a compact
+    single-line JSON summary, so a tail-kept (head-truncated) driver
+    artifact never loses the judged numbers inside the one huge
+    full-record line."""
+
+    REC = {
+        "metric": "ResNet-50 images/sec/chip",
+        "value": 123.4,
+        "unit": "images/sec/chip",
+        "vs_baseline": 1.15,
+        "huge_detail": {"x": list(range(1000))},
+        "secondary": {
+            "llama": {"value": 9.9, "vs_baseline": 1.58,
+                      "arms": {"deep": "stuff"}},
+            "gosgd": {"error": "RuntimeError: " + "x" * 500},
+        },
+    }
+
+    def test_compact_parseable_and_headline_preserved(self):
+        line = _headline_line(self.REC)
+        assert line.startswith("BENCH_HEADLINE ")
+        d = json.loads(line[len("BENCH_HEADLINE "):])
+        assert d["value"] == 123.4 and d["vs_baseline"] == 1.15
+        assert d["secondary"]["llama"] == {
+            "value": 9.9, "vs_baseline": 1.58,
+        }
+        # errors collapse to a bounded string; details are dropped
+        assert len(d["secondary"]["gosgd"]["error"]) <= 120
+        assert "huge_detail" not in d
+
+    def test_stays_compact(self):
+        """The whole point: the summary must survive a tail-bytes
+        capture window, so it stays small no matter the record."""
+        assert len(_headline_line(self.REC)) < 2000
+
+    def test_focused_run_without_secondary(self):
+        d = json.loads(
+            _headline_line({"metric": "m", "value": 1, "unit": "u",
+                            "vs_baseline": None})[len("BENCH_HEADLINE "):]
+        )
+        assert d == {"metric": "m", "value": 1, "unit": "u",
+                     "vs_baseline": None}

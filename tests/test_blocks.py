@@ -1,0 +1,242 @@
+"""Paged KV-cache host-side bookkeeping (serving v2):
+``serving/blocks.py`` (allocator, tables, copy-on-write gate) and
+``serving/prefix_cache.py`` (block-granularity radix cache).
+
+Pure host logic — no device work, fast tier.  The device side
+(block-table attention, bitwise guarantees, engine integration) is
+``tests/test_serving_paged.py``.
+"""
+
+import pytest
+
+from theanompi_tpu.serving.blocks import (
+    BlockAllocator,
+    BlockManager,
+    OutOfBlocks,
+)
+from theanompi_tpu.serving.prefix_cache import PrefixCache
+
+pytestmark = pytest.mark.serving
+
+
+class TestBlockAllocator:
+    def test_alloc_free_refcount_roundtrip(self):
+        a = BlockAllocator(4, block_size=8)
+        b0, b1 = a.alloc(), a.alloc()
+        assert (b0, b1) == (0, 1)           # deterministic low-first
+        assert a.blocks_in_use == 2 and a.blocks_free == 2
+        assert a.refcount(b0) == 1
+        a.ref(b0)
+        assert a.refcount(b0) == 2
+        assert not a.deref(b0)              # still shared
+        assert a.deref(b0)                  # now freed
+        assert a.blocks_free == 3
+        assert a.deref(b1)
+        assert a.stats()["n_frees"] == 2
+
+    def test_freed_block_is_reusable(self):
+        a = BlockAllocator(1, block_size=4)
+        b = a.alloc()
+        a.deref(b)
+        assert a.alloc() == b
+
+    def test_exhaustion_raises_loud_with_state(self):
+        a = BlockAllocator(2, block_size=4)
+        a.alloc(), a.alloc()
+        with pytest.raises(OutOfBlocks) as ei:
+            a.alloc()
+        assert ei.value.state["blocks_free"] == 0
+        assert a.n_oom == 1
+
+    def test_alloc_many_is_atomic(self):
+        """A failed multi-block request leaks nothing: the free list
+        is untouched."""
+        a = BlockAllocator(3, block_size=4)
+        a.alloc()
+        with pytest.raises(OutOfBlocks):
+            a.alloc_many(3)
+        assert a.blocks_free == 2 and a.n_oom == 1
+        assert len(a.alloc_many(2)) == 2
+
+    def test_peak_tracking(self):
+        a = BlockAllocator(4, block_size=4)
+        bs = a.alloc_many(3)
+        for b in bs:
+            a.deref(b)
+        assert a.peak_in_use == 3 and a.blocks_in_use == 0
+
+
+class TestBlockManager:
+    def mgr(self, n_blocks=8, block_size=4, max_slots=2, max_seq=16):
+        return BlockManager(
+            n_blocks=n_blocks, block_size=block_size,
+            max_slots=max_slots, max_seq=max_seq,
+        )
+
+    def test_assign_grow_free(self):
+        m = self.mgr()
+        assert m.blocks_for(5) == 2
+        m.assign(0, [], 2)
+        assert m.n_owned[0] == 2
+        assert list(m.tables[0]) == [0, 1, m.trash_id, m.trash_id]
+        m.grow(0, 2)
+        assert m.n_owned[0] == 3
+        m.free_slot(0)
+        assert m.allocator.blocks_in_use == 0
+        assert (m.tables[0] == m.trash_id).all()
+
+    def test_assign_adopts_shared_blocks(self):
+        """Adopted entries transfer the caller's reference to the
+        table; freeing the slot releases only that reference."""
+        m = self.mgr()
+        m.assign(0, [], 2)
+        shared = int(m.tables[0, 0])
+        m.allocator.ref(shared)             # what match() would do
+        m.assign(1, [shared], 2)
+        assert m.allocator.refcount(shared) == 2
+        m.free_slot(1)
+        assert m.allocator.refcount(shared) == 1   # slot 0 lives on
+
+    def test_cow_on_shared_block(self):
+        m = self.mgr()
+        m.assign(0, [], 2)
+        shared = int(m.tables[0, 0])
+        m.allocator.ref(shared)
+        m.assign(1, [shared], 2)
+        copies = []
+        assert m.ensure_writable(1, 0, lambda s, d: copies.append((s, d)))
+        (src, dst), = copies
+        assert src == shared and dst == int(m.tables[1, 0]) != shared
+        assert m.allocator.refcount(shared) == 1   # ref dropped
+        assert m.allocator.refcount(dst) == 1
+        assert m.allocator.n_cow == 1
+
+    def test_exclusive_block_skips_cow(self):
+        m = self.mgr()
+        m.assign(0, [], 1)
+        assert not m.ensure_writable(
+            0, 0, lambda s, d: pytest.fail("copied an exclusive block")
+        )
+
+    def test_assign_out_of_blocks_is_atomic(self):
+        """On failure the adopted references are NOT consumed and no
+        fresh block leaked."""
+        m = self.mgr(n_blocks=3)
+        m.assign(0, [], 2)
+        shared = int(m.tables[0, 0])
+        m.allocator.ref(shared)
+        with pytest.raises(OutOfBlocks):
+            m.assign(1, [shared], 3)        # needs 2 fresh, 1 left
+        assert m.n_owned[1] == 0
+        assert m.allocator.refcount(shared) == 2   # caller still owns
+        m.release_adopted([shared])
+        assert m.allocator.refcount(shared) == 1
+
+
+def build_cache(n_blocks=16, bs=4):
+    alloc = BlockAllocator(n_blocks, block_size=bs)
+    return PrefixCache(alloc), alloc
+
+
+class TestPrefixCache:
+    def test_miss_on_empty(self):
+        pc, _ = build_cache()
+        assert pc.match([1, 2, 3]) == (0, [])
+
+    def test_insert_match_full_and_partial(self):
+        """A 10-token prompt at block_size 4 caches 2 full + 1
+        partial block; an identical lookup matches all three, capped
+        at max_len."""
+        pc, alloc = build_cache()
+        blocks = alloc.alloc_many(3)
+        toks = [1, 2, 3, 4, 5, 6, 7, 8, 9, 10]
+        assert pc.insert(toks, blocks) == 3
+        assert alloc.refcount(blocks[0]) == 2      # owner + cache
+        n, got = pc.match(toks, max_len=9)
+        assert n == 9 and got == blocks            # partial tail hit
+        assert alloc.refcount(blocks[2]) == 3      # cache + owner + us
+        for b in got:
+            alloc.deref(b)
+
+    def test_divergent_tail_matches_common_prefix(self):
+        pc, alloc = build_cache()
+        blocks = alloc.alloc_many(2)
+        pc.insert([1, 2, 3, 4, 5, 6], blocks)
+        # same first block, diverges inside the partial second
+        n, got = pc.match([1, 2, 3, 4, 5, 99, 7])
+        assert n == 5 and got == blocks
+        for b in got:
+            alloc.deref(b)
+        # divergence inside the FIRST (full) block
+        n, got = pc.match([1, 2, 99, 4])
+        assert n == 2 and got == [blocks[0]]
+        alloc.deref(got[0])
+
+    def test_reinsert_keeps_existing_nodes(self):
+        pc, alloc = build_cache()
+        b1 = alloc.alloc_many(2)
+        pc.insert([1, 2, 3, 4, 5], b1)
+        b2 = alloc.alloc_many(2)
+        pc.insert([1, 2, 3, 4, 5], b2)     # same tokens, new blocks
+        assert alloc.refcount(b1[0]) == 2  # cache kept the original
+        assert alloc.refcount(b2[0]) == 1  # duplicate not cached
+        assert pc.n_nodes() == 2
+
+    def test_evict_lru_unreferenced_only(self):
+        """Eviction frees LRU leaves the cache alone holds; blocks a
+        live slot still references are skipped."""
+        pc, alloc = build_cache(n_blocks=4)
+        ba = alloc.alloc_many(2)
+        pc.insert([1, 2, 3, 4, 5, 6, 7, 8], ba)
+        for b in ba:
+            alloc.deref(b)                 # cache is sole owner
+        bb = [alloc.alloc()]
+        pc.insert([9, 9, 9, 9], bb)        # bb still slot-referenced
+        _, touched = pc.match([1, 2, 3, 4])  # touch ba's first block
+        for b in touched:
+            alloc.deref(b)                 # give back the match ref
+        # leaf of the ba chain (block ba[1]) is the LRU evictable
+        assert pc.evict(1) == 1
+        assert alloc.refcount(ba[0]) == 1  # parent survives
+        assert pc.evict(10) == 1           # then ba[0]; bb skipped
+        assert alloc.refcount(bb[0]) == 2  # still cached + referenced
+        assert pc.stats()["evicted_blocks"] == 2
+
+    def test_clear_releases_everything(self):
+        pc, alloc = build_cache()
+        bs = alloc.alloc_many(2)
+        pc.insert([1, 2, 3, 4, 5, 6, 7, 8], bs)
+        for b in bs:
+            alloc.deref(b)
+        assert pc.clear() == 2
+        assert alloc.blocks_in_use == 0 and pc.n_nodes() == 0
+
+    def test_stats_hit_accounting(self):
+        pc, alloc = build_cache()
+        bs = alloc.alloc_many(1)
+        pc.insert([1, 2, 3], bs)
+        pc.match([1, 2, 3])
+        pc.match([7, 7])
+        s = pc.stats()
+        assert s["n_lookups"] == 2 and s["n_hits"] == 1
+        assert s["matched_tokens"] == 3
+
+    def test_unrecord_match_rolls_back_stats(self):
+        # a requeued queue head re-matches every engine step; the
+        # abandoned attempts must not inflate hit-rate telemetry
+        pc, alloc = build_cache()
+        bs = alloc.alloc_many(1)
+        pc.insert([1, 2, 3], bs)
+        for _ in range(5):                 # 5 failed admissions
+            matched, blocks = pc.match([1, 2, 3, 9])
+            for b in blocks:
+                alloc.deref(b)             # release_adopted
+            pc.unrecord_match(matched)
+        matched, blocks = pc.match([1, 2, 3, 9])   # the one that admits
+        s = pc.stats()
+        assert s["n_lookups"] == 1 and s["n_hits"] == 1
+        assert s["matched_tokens"] == matched == 3
+        # misses roll back too (lookup count only)
+        _, none = pc.match([7, 7])
+        pc.unrecord_match(0)
+        assert not none and pc.stats()["n_lookups"] == 1

@@ -270,6 +270,23 @@ ENTRY %main {
         assert "dot.7" not in names
         assert "all-to-all.4" not in names
 
+    def test_hlo_instruction_names_covers_unmarked_ops(self):
+        """The cross-module collision subtrahend must include EVERY
+        instruction name, op_name metadata or not — a foreign
+        module's bare 'fusion.1' still emits trace events."""
+        from theanompi_tpu.utils.trace_comm import hlo_instruction_names
+
+        hlo = '''
+HloModule jit_prefill
+ENTRY %main {
+  %fusion.1 = f32[8]{0} fusion(...), metadata={op_name="jit(prefill)/attn"}
+  %dot.7 = f32[8,8]{1,0} dot(...)
+  ROOT %tuple.2 = (f32[8]{0}) tuple(%fusion.1)
+}
+'''
+        names = hlo_instruction_names(hlo)
+        assert {"fusion.1", "dot.7", "tuple.2"} <= names
+
     def test_comm_report_sums_quant_ops(self, tmp_path):
         """quant ops count as compute for the hidden/exposed split AND
         sum into quant_s."""

@@ -1284,13 +1284,17 @@ class Llama(TMModel):
 
     # -- serving (theanompi_tpu/serving) ----------------------------------
 
-    def make_decoder(self, **kw):
+    def make_decoder(self, *, paged: bool = False, **kw):
         """KV-cache inference decoder over this model's (compiled,
         possibly checkpoint-restored) params — the train → checkpoint
-        → serve path.  See ``theanompi_tpu.serving.LlamaDecoder``."""
-        from theanompi_tpu.serving import LlamaDecoder
+        → serve path.  ``paged=True`` builds the block-table /
+        prefix-cache decoder.  See
+        ``theanompi_tpu.serving.LlamaDecoder`` /
+        ``PagedLlamaDecoder``."""
+        from theanompi_tpu.serving import LlamaDecoder, PagedLlamaDecoder
 
-        return LlamaDecoder(self, **kw)
+        cls = PagedLlamaDecoder if paged else LlamaDecoder
+        return cls(self, **kw)
 
     # -- checkpoint (save/load/adjust_hyperp inherited from TMModel) ------
 

@@ -1,18 +1,31 @@
 """Continuous-batching inference serving (the roadmap's "serve heavy
 traffic" workload): KV-cache decode for Llama + a slot-based engine.
 
-- ``decoder`` — model layer: tp-sharded GQA KV cache, bucketed
-  ``prefill`` + single-token ``decode_step``, layout-invariant
-  greedy/temperature samplers (``parallel/tp.py``).
+- ``decoder`` — model layer: tp-sharded GQA KV cache (slot-contiguous
+  v1 ``LlamaDecoder``, or the v2 ``PagedLlamaDecoder``: block-table
+  attention over fixed-size KV blocks, fixed-shape chunked prefill),
+  layout-invariant greedy/temperature samplers (``parallel/tp.py``).
+- ``blocks`` — host-side paged-cache accounting: refcounted block
+  allocator, per-slot block tables, the copy-on-write gate.
+- ``prefix_cache`` — radix/trie prefix cache keyed on token ids: a
+  shared system prompt is prefilled once and ADOPTED by later
+  requests (refcount bump + CoW on first divergent write).
 - ``engine`` — Orca-style continuous batcher behind a thread-safe
   ``Engine.submit()`` front-end with admission control (queue cap +
-  per-request deadlines → load-shed results, never hangs).
+  per-request deadlines + out-of-blocks accounting → load-shed
+  results, never hangs) and chunked prefill interleaved with decode.
 
 See docs/SERVING.md for lifecycle, knobs and telemetry.
 """
 
+from theanompi_tpu.serving.blocks import (
+    BlockAllocator,
+    BlockManager,
+    OutOfBlocks,
+)
 from theanompi_tpu.serving.decoder import (
     LlamaDecoder,
+    PagedLlamaDecoder,
     decoder_from_checkpoint,
     default_prefill_buckets,
 )
@@ -22,10 +35,16 @@ from theanompi_tpu.serving.engine import (
     Result,
     ServingFuture,
 )
+from theanompi_tpu.serving.prefix_cache import PrefixCache
 
 __all__ = [
+    "BlockAllocator",
+    "BlockManager",
     "Engine",
     "LlamaDecoder",
+    "OutOfBlocks",
+    "PagedLlamaDecoder",
+    "PrefixCache",
     "Request",
     "Result",
     "ServingFuture",

@@ -1,6 +1,26 @@
 """Model layer of the serving engine: GQA-aware, tp-sharded KV-cache
 decode for ``models/llama.py``.
 
+Two cache organisations share one module:
+
+- ``LlamaDecoder`` (v1) — slot-contiguous cache
+  ``[slots, kv_heads/tp, max_seq, hd]``: every slot owns ``max_seq``
+  HBM rows whether it uses them or not.
+- ``PagedLlamaDecoder`` (v2) — paged cache: per-layer block POOLS
+  ``[n_blocks + 1, kv_heads/tp, block_size, hd]`` plus per-slot BLOCK
+  TABLES (``serving/blocks.py``); decode reads K/V through the table
+  with a gather and writes through it with a scatter, so HBM is
+  proportional to tokens actually cached, blocks are shareable
+  (radix prefix cache, ``serving/prefix_cache.py``) and long prompts
+  prefill in fixed-size CHUNKS interleaved with decode steps.  The
+  decode executable's HLO shape depends only on
+  (slots, max_blocks_per_slot, block_size) — table contents, chunk
+  boundaries, sharing and copy-on-write are all DATA, so the
+  one-compile discipline survives paging (``n_decode_compiles`` /
+  ``n_prefill_compiles`` are the tested bounds).  The extra pool row
+  is the TRASH block: inactive slots and padding rows write there,
+  which keeps the executables branch-free.
+
 Two fixed-shape jitted functions per decoder (the vLLM/Orca split):
 
 - ``prefill`` — run one request's prompt through the full causal
@@ -51,6 +71,8 @@ from theanompi_tpu.models.llama import (
 from theanompi_tpu.ops.attention import NEG_INF, flash_attention
 from theanompi_tpu.parallel import MODEL_AXIS, dp_replicas, make_mesh
 from theanompi_tpu.parallel import tp as tp_lib
+from theanompi_tpu.serving.blocks import BlockManager
+from theanompi_tpu.serving.prefix_cache import PrefixCache
 
 
 def default_prefill_buckets(max_prefill: int, base: int = 16) -> tuple:
@@ -80,6 +102,8 @@ class LlamaDecoder:
     ``sp > 1`` and MoE models are not yet servable.
     """
 
+    paged = False
+
     def __init__(
         self,
         model: Llama,
@@ -88,6 +112,23 @@ class LlamaDecoder:
         max_seq: int | None = None,
         prefill_buckets: tuple | None = None,
     ):
+        self._init_common(model, max_slots, max_seq)
+        self.prefill_buckets = tuple(
+            sorted(prefill_buckets)
+            if prefill_buckets else default_prefill_buckets(self.max_prefill)
+        )
+        assert self.prefill_buckets[-1] == self.max_prefill, (
+            f"largest prefill bucket {self.prefill_buckets[-1]} must "
+            f"equal max_prefill {self.max_prefill}"
+        )
+
+        m = model
+        # KV cache: one {k, v} pair per layer, [S, Hkv/tp, T, hd] in
+        # compute dtype, kv-head dim sharded over the model axis
+        shape = (self.max_slots, m.n_kv_heads, self.max_seq, self._hd)
+        self.cache = self._zeros_cache(shape)
+
+    def _init_common(self, model: Llama, max_slots, max_seq) -> None:
         if model.mesh is None or model.params is None:
             raise ValueError(
                 "LlamaDecoder needs a compiled model: call "
@@ -107,47 +148,40 @@ class LlamaDecoder:
         # decode appends one position past the prompt per token, so
         # the longest servable prompt leaves room for >= 1 new token
         self.max_prefill = self.max_seq - 1
-        self.prefill_buckets = tuple(
-            sorted(prefill_buckets)
-            if prefill_buckets else default_prefill_buckets(self.max_prefill)
-        )
-        assert self.prefill_buckets[-1] == self.max_prefill, (
-            f"largest prefill bucket {self.prefill_buckets[-1]} must "
-            f"equal max_prefill {self.max_prefill}"
-        )
 
-        m = model
-        self._h_loc = m.n_heads // m.tp
-        self._hkv_loc = m.n_kv_heads // m.tp
+        self._h_loc = model.n_heads // model.tp
+        self._hkv_loc = model.n_kv_heads // model.tp
         self._rep = self._h_loc // self._hkv_loc
-        self._hd = m.head_dim
-        self._cdtype = m.compute_dtype
-
-        # KV cache: one {k, v} pair per layer, [S, Hkv/tp, T, hd] in
-        # compute dtype, kv-head dim sharded over the model axis
+        self._hd = model.head_dim
+        self._cdtype = model.compute_dtype
         kv_spec = P(None, MODEL_AXIS, None, None)
         self._cache_specs = [
-            {"k": kv_spec, "v": kv_spec} for _ in range(m.n_layers)
+            {"k": kv_spec, "v": kv_spec} for _ in range(model.n_layers)
         ]
-        shape = (self.max_slots, m.n_kv_heads, self.max_seq, self._hd)
-        sharding = NamedSharding(self.mesh, kv_spec)
+
+        # compiled variants: decode keyed by the static all-greedy
+        # flag, prefill by (bucket/chunk, greedy) — the compile count
+        # is bounded by 2 x the shape-key count, a tested guarantee
+        self._decode_fns: dict[bool, object] = {}
+        self._prefill_fns: dict[tuple[int, bool], object] = {}
+
+    def _zeros_cache(self, shape):
+        """Per-layer {k, v} zeros of ``shape``, kv-head dim sharded
+        over the model axis (used for the contiguous cache AND the
+        paged block pools — only the shape differs)."""
+        sharding = NamedSharding(self.mesh, P(None, MODEL_AXIS, None, None))
 
         def _zeros():
             z = jnp.zeros(shape, self._cdtype)
-            return [{"k": z, "v": z} for _ in range(m.n_layers)]
+            return [{"k": z, "v": z} for _ in range(self.model.n_layers)]
 
-        self.cache = jax.jit(
+        return jax.jit(
             _zeros,
             out_shardings=[
-                {"k": sharding, "v": sharding} for _ in range(m.n_layers)
+                {"k": sharding, "v": sharding}
+                for _ in range(self.model.n_layers)
             ],
         )()
-
-        # compiled variants: decode keyed by the static all-greedy
-        # flag, prefill by (bucket, greedy) — the compile count is
-        # bounded by 2 x (1 + bucket-ladder length)
-        self._decode_fns: dict[bool, object] = {}
-        self._prefill_fns: dict[tuple[int, bool], object] = {}
 
     # -- device bodies (run on LOCAL shards inside shard_map) -------------
 
@@ -164,18 +198,24 @@ class LlamaDecoder:
         static all-greedy fast path: pure ``sharded_argmax``, no
         Gumbel draw, no key fold — bitwise-identical ids to the
         sampling path at temperature<=0 (both argmax the same f32
-        logits), so batch composition never changes outputs."""
-        if greedy:
-            return tp_lib.sharded_argmax(
-                logits.astype(jnp.float32), self.model.vocab
+        logits), so batch composition never changes outputs.
+
+        Wrapped in a ``serving_sample`` named scope so its fused HLO
+        is attributable from profiler traces (PR 4's
+        ``trace_comm.scope_op_names`` technique — the bench's
+        sampler-cost datum)."""
+        with jax.named_scope("serving_sample"):
+            if greedy:
+                return tp_lib.sharded_argmax(
+                    logits.astype(jnp.float32), self.model.vocab
+                )
+            # the token that will sit at position pos+1 samples with
+            # fold_in(request_key, pos+1) — position-keyed, so batched
+            # and single-request decodes draw identical noise
+            skeys = jax.vmap(jax.random.fold_in)(keys, pos + 1)
+            return tp_lib.sharded_sample(
+                logits, self.model.vocab, skeys, temps
             )
-        # the token that will sit at position pos+1 samples with
-        # fold_in(request_key, pos+1) — position-keyed, so batched
-        # and single-request decodes draw identical noise
-        skeys = jax.vmap(jax.random.fold_in)(keys, pos + 1)
-        return tp_lib.sharded_sample(
-            logits, self.model.vocab, skeys, temps
-        )
 
     def _decode_body(self, params, cache, tokens, lengths, keys, temps,
                      greedy: bool):
@@ -390,6 +430,487 @@ class LlamaDecoder:
         guarantee under test)."""
         return len(self._prefill_fns)
 
+    @property
+    def n_decode_compiles(self) -> int:
+        """Compiled decode variants so far — bounded by 2 (greedy
+        fast path + sampling).  The bench's serving sweep asserts
+        this never grows with batch composition, table contents, or
+        offered load."""
+        return len(self._decode_fns)
+
+    def kv_cache_bytes(self) -> int:
+        """Total HBM the KV cache occupies (all layers, global across
+        tp shards)."""
+        m = self.model
+        itemsize = jnp.dtype(self._cdtype).itemsize
+        return (
+            2 * m.n_layers * self.max_slots * m.n_kv_heads
+            * self.max_seq * self._hd * itemsize
+        )
+
+    def kv_bytes_per_slot(self) -> int:
+        """HBM one admitted request costs — for the contiguous cache,
+        ``max_seq`` rows regardless of how many it uses (the paged
+        decoder's version is proportional to blocks actually held)."""
+        return self.kv_cache_bytes() // self.max_slots
+
+    def _dummy_decode_args(self) -> tuple:
+        s = self.max_slots
+        return (
+            self.model.params, self.cache,
+            jnp.zeros((s,), jnp.int32), jnp.zeros((s,), jnp.int32),
+            jnp.zeros((s, 2), jnp.uint32), jnp.zeros((s,), jnp.float32),
+        )
+
+    def decode_hlo_text(self, greedy: bool = True) -> str:
+        """Optimized-HLO text of the decode executable (one AOT
+        lower/compile — not served from the jit call cache, so fetch
+        it once and scan for every marker set you need)."""
+        from theanompi_tpu.utils.trace_comm import compiled_hlo_text
+
+        lowered = self._decode_jit(greedy).lower(
+            *self._dummy_decode_args()
+        )
+        return compiled_hlo_text(lowered.compile())
+
+    def decode_scope_op_names(
+        self, markers: tuple, greedy: bool = True
+    ) -> set:
+        """HLO instruction names of the decode executable whose
+        name-stack mentions any of ``markers`` (``serving_sample``,
+        ``paged_attend``, ``kv_write``) — feed to
+        ``trace_comm.comm_report(quant_ops=...)`` to attribute their
+        share of a traced decode run (the sampler/attention cost
+        split the bench's serving row reports)."""
+        from theanompi_tpu.utils.trace_comm import scope_op_names
+
+        return scope_op_names(
+            self.decode_hlo_text(greedy), markers=tuple(markers)
+        )
+
+
+class PagedLlamaDecoder(LlamaDecoder):
+    """Paged-KV-cache decoder (serving v2): block pools + per-slot
+    block tables instead of a slot-contiguous cache.
+
+    - K/V live in per-layer POOLS ``[n_blocks + 1, Hkv/tp,
+      block_size, hd]`` (the ``+1`` row is the TRASH block — padding
+      and inactive-slot writes land there, never read unmasked).
+    - Each slot's BLOCK TABLE (``[max_blocks]`` int32, padded with
+      the trash id) maps logical block index → physical block.
+      Decode WRITES through the table with a scatter and READS with
+      a gather, so the executable's HLO shape depends only on
+      (max_slots, max_blocks, block_size): sharing, copy-on-write
+      and chunked prefill are all table DATA.
+    - Prefill runs in fixed-size CHUNKS of ``prefill_chunk`` token
+      positions through ONE executable shape: ``prefill(table_row,
+      ids, start, q_len, key, temp)`` processes the prompt span
+      ``[start, start + q_len)`` against the already-cached history
+      (adopted prefix blocks included) — the engine interleaves
+      chunks with decode steps so a long arrival never stalls
+      in-flight TPOT.  One executable shape also makes chunked ==
+      monolithic and prefix-hit == cold bitwise: a token row's
+      compute depends only on its own (token, position, cached
+      prefix), never on its neighbours in the chunk.
+
+    The bitwise guarantees of v1 survive: sampled ids are identical
+    tp=1 vs tp=2 (vocab-sharded samplers), batched == single-request
+    (slots are independent rows reading only their own blocks), and
+    the greedy fast path still dispatches a Gumbel-free executable.
+
+    Block bookkeeping (``self.manager``) and the radix prefix cache
+    (``self.prefix_cache`` — shared across engines over this
+    decoder, as warm cache state should be) are host-side; the
+    engine drives admission, CoW, growth and eviction through them.
+    """
+
+    paged = True
+
+    def __init__(
+        self,
+        model: Llama,
+        *,
+        max_slots: int = 8,
+        max_seq: int | None = None,
+        block_size: int = 16,
+        n_blocks: int | None = None,
+        prefill_chunk: int | None = None,
+        prefix_cache: bool = True,
+    ):
+        self._init_common(model, max_slots, max_seq)
+        self.block_size = int(block_size)
+        self.manager = BlockManager(
+            n_blocks=None if n_blocks is None else int(n_blocks),
+            block_size=self.block_size,
+            max_slots=self.max_slots, max_seq=self.max_seq,
+        )
+        # the manager owns the table-width derivation; executable
+        # shapes (gather padding, dummy args) adopt it
+        self.max_blocks = self.manager.max_blocks
+        self.trash_id = self.manager.trash_id
+        self.prefix_cache = (
+            PrefixCache(self.manager.allocator) if prefix_cache else None
+        )
+        self.prefill_chunk = int(
+            prefill_chunk if prefill_chunk is not None
+            else min(64, self.max_prefill)
+        )
+        assert 1 <= self.prefill_chunk <= self.max_prefill
+
+        m = model
+        shape = (self.manager.allocator.n_blocks + 1, m.n_kv_heads,
+                 self.block_size, self._hd)
+        self.pools = self._zeros_cache(shape)
+        self._copy_fn = None
+
+    # -- device bodies -----------------------------------------------------
+
+    def _write_kv(self, pool, k, v, bids, offs):
+        """Scatter per-row K/V ``[N, Hkv/tp, hd]`` into the pools at
+        (block id, offset) per row.  Rows routed to the trash block
+        may collide — their content is never read unmasked, so the
+        scatter order is irrelevant to outputs."""
+        with jax.named_scope("kv_write"):
+            return {
+                "k": pool["k"].at[bids, :, offs, :].set(
+                    k.astype(self._cdtype)
+                ),
+                "v": pool["v"].at[bids, :, offs, :].set(
+                    v.astype(self._cdtype)
+                ),
+            }
+
+    def _gather_kv(self, pool, tables):
+        """Block-table read: ``tables`` [..., MB] int32 → K/V
+        [..., Hkv/tp, MB * block_size, hd] in position order."""
+        mb, bs = self.max_blocks, self.block_size
+
+        def one(arr):
+            g = arr[tables]            # [..., MB, Hkv, bs, hd]
+            if tables.ndim == 2:
+                g = g.transpose(0, 2, 1, 3, 4)
+                return g.reshape(
+                    g.shape[0], self._hkv_loc, mb * bs, self._hd
+                )
+            g = g.transpose(1, 0, 2, 3)
+            return g.reshape(self._hkv_loc, mb * bs, self._hd)
+
+        return one(pool["k"]), one(pool["v"])
+
+    def _decode_body(self, params, pools, tables, tokens, lengths,
+                     keys, temps, active, greedy: bool):
+        """One token for all slots through the block tables.
+        tables [S, MB] int32, active [S] bool (False → writes routed
+        to trash, outputs ignored by the engine); everything else as
+        v1."""
+        m = self.model
+        s = self.max_slots
+        bs = self.block_size
+        t_pad = self.max_blocks * bs
+        hd, h_loc, hkv_loc, rep = (
+            self._hd, self._h_loc, self._hkv_loc, self._rep
+        )
+        x = tp_lib.embed_lookup(
+            tokens[:, None], params["embed"], m.vocab
+        )[:, 0, :].astype(self._cdtype)                       # [S, D]
+        pos = lengths                          # write position per slot
+        valid = (
+            jnp.arange(t_pad)[None, :] <= pos[:, None]
+        )[:, None, None, :]                            # [S, 1, 1, T]
+        bidx = jnp.clip(pos // bs, 0, self.max_blocks - 1)
+        wbid = jnp.where(
+            active, tables[jnp.arange(s), bidx], self.trash_id
+        )
+        woff = pos % bs
+
+        new_pools = []
+        for layer_pool, p in zip(pools, params["layers"]):
+            xn = rms_norm(x, p["attn_norm"])
+            q = tp_lib.col_parallel(xn, p["wq"]).reshape(s, h_loc, hd)
+            k = tp_lib.col_parallel(xn, p["wk"]).reshape(s, hkv_loc, hd)
+            v = tp_lib.col_parallel(xn, p["wv"]).reshape(s, hkv_loc, hd)
+            q = rope_at(q, pos)
+            k = rope_at(k, pos)
+            lp = self._write_kv(layer_pool, k, v, wbid, woff)
+            new_pools.append(lp)
+            with jax.named_scope("paged_attend"):
+                kg, vg = self._gather_kv(lp, tables)  # [S, Hkv, T, hd]
+                qg = q.reshape(s, hkv_loc, rep, hd)
+                scores = jnp.einsum("skrd,sktd->skrt", qg, kg).astype(
+                    jnp.float32
+                ) * (hd ** -0.5)
+                scores = jnp.where(valid, scores, NEG_INF)
+                probs = jax.nn.softmax(scores, axis=-1)
+                o = jnp.einsum(
+                    "skrt,sktd->skrd", probs.astype(vg.dtype), vg
+                ).reshape(s, h_loc * hd)
+            x = x + tp_lib.row_parallel(o, p["wo"]).astype(self._cdtype)
+            x = self._mlp(p, x)
+
+        xf = rms_norm(x, params["final_norm"])
+        logits = tp_lib.col_parallel(xf, params["lm_head"])  # [S, V/tp]
+        nxt = self._sample(logits, keys, pos, temps, greedy)
+        return new_pools, nxt
+
+    def _prefill_body(self, params, pools, table_row, ids, start,
+                      q_len, key, temp, greedy: bool):
+        """One prefill CHUNK for one request: ids [C] int32
+        (zero-padded past ``q_len``) occupy absolute positions
+        ``[start, start + q_len)``; K/V rows scatter through
+        ``table_row`` [MB]; attention reads the gathered history
+        (adopted prefix blocks + earlier chunks + this chunk) under
+        an absolute-position causal mask.  Samples the token that
+        follows position ``start + q_len - 1`` — meaningful only on
+        the final chunk (the engine discards the rest)."""
+        m = self.model
+        bs = self.block_size
+        t_pad = self.max_blocks * bs
+        hd, h_loc, hkv_loc, rep = (
+            self._hd, self._h_loc, self._hkv_loc, self._rep
+        )
+        c = ids.shape[0]
+        x = tp_lib.embed_lookup(
+            ids[None, :], params["embed"], m.vocab
+        )[0].astype(self._cdtype)                             # [C, D]
+        pos = start + jnp.arange(c)
+        in_range = jnp.arange(c) < q_len
+        bidx = jnp.clip(pos // bs, 0, self.max_blocks - 1)
+        wbid = jnp.where(in_range, table_row[bidx], self.trash_id)
+        woff = pos % bs
+        valid = (
+            jnp.arange(t_pad)[None, :] <= pos[:, None]
+        )[:, None, None, :]                            # [C, 1, 1, T]
+
+        new_pools = []
+        for layer_pool, p in zip(pools, params["layers"]):
+            xn = rms_norm(x, p["attn_norm"])
+            q = tp_lib.col_parallel(xn, p["wq"]).reshape(c, h_loc, hd)
+            k = tp_lib.col_parallel(xn, p["wk"]).reshape(c, hkv_loc, hd)
+            v = tp_lib.col_parallel(xn, p["wv"]).reshape(c, hkv_loc, hd)
+            q = rope_at(q, pos)
+            k = rope_at(k, pos)
+            lp = self._write_kv(layer_pool, k, v, wbid, woff)
+            new_pools.append(lp)
+            with jax.named_scope("paged_attend"):
+                kg, vg = self._gather_kv(lp, table_row)  # [Hkv, T, hd]
+                qg = q.reshape(c, hkv_loc, rep, hd)
+                scores = jnp.einsum("ckrd,ktd->ckrt", qg, kg).astype(
+                    jnp.float32
+                ) * (hd ** -0.5)
+                scores = jnp.where(
+                    valid.reshape(c, 1, 1, t_pad), scores, NEG_INF
+                )
+                probs = jax.nn.softmax(scores, axis=-1)
+                o = jnp.einsum(
+                    "ckrt,ktd->ckrd", probs.astype(vg.dtype), vg
+                ).reshape(c, h_loc * hd)
+            x = x + tp_lib.row_parallel(o, p["wo"]).astype(self._cdtype)
+            x = self._mlp(p, x)
+
+        xf = rms_norm(x, params["final_norm"])
+        # only the chunk's LAST VALID row matters for sampling
+        x_last = lax.dynamic_slice(
+            xf, (q_len - 1, 0), (1, xf.shape[-1])
+        )                                                   # [1, D]
+        logits = tp_lib.col_parallel(x_last, params["lm_head"])
+        # the next token sits at position start + q_len: _sample
+        # folds pos+1, so pass start + q_len - 1 (same policy as
+        # decode and the v1 prefill)
+        tok = self._sample(
+            logits, key[None], jnp.reshape(start + q_len - 1, (1,)),
+            temp[None], greedy,
+        )[0]
+        return new_pools, tok
+
+    # -- compiled entry points ---------------------------------------------
+
+    def _decode_jit(self, greedy: bool):
+        fn = self._decode_fns.get(greedy)
+        if fn is None:
+            import functools
+
+            rep = P()
+            fn = jax.jit(
+                jax.shard_map(
+                    functools.partial(self._decode_body, greedy=greedy),
+                    mesh=self.mesh,
+                    in_specs=(self.model._specs, self._cache_specs,
+                              rep, rep, rep, rep, rep, rep),
+                    out_specs=(self._cache_specs, rep),
+                    check_vma=False,
+                ),
+                donate_argnums=(1,),
+            )
+            self._decode_fns[greedy] = fn
+        return fn
+
+    def _prefill_jit(self, greedy: bool):
+        fn = self._prefill_fns.get((self.prefill_chunk, greedy))
+        if fn is None:
+            import functools
+
+            rep = P()
+            fn = jax.jit(
+                jax.shard_map(
+                    functools.partial(
+                        self._prefill_body, greedy=greedy
+                    ),
+                    mesh=self.mesh,
+                    in_specs=(self.model._specs, self._cache_specs,
+                              rep, rep, rep, rep, rep, rep),
+                    out_specs=(self._cache_specs, rep),
+                    check_vma=False,
+                ),
+                donate_argnums=(1,),
+            )
+            self._prefill_fns[(self.prefill_chunk, greedy)] = fn
+        return fn
+
+    def _copy_jit(self):
+        if self._copy_fn is None:
+            def body(pools, src, dst):
+                return [
+                    {
+                        "k": lp["k"].at[dst].set(lp["k"][src]),
+                        "v": lp["v"].at[dst].set(lp["v"][src]),
+                    }
+                    for lp in pools
+                ]
+
+            rep = P()
+            self._copy_fn = jax.jit(
+                jax.shard_map(
+                    body, mesh=self.mesh,
+                    in_specs=(self._cache_specs, rep, rep),
+                    out_specs=self._cache_specs,
+                    check_vma=False,
+                ),
+                donate_argnums=(0,),
+            )
+        return self._copy_fn
+
+    def bucket_for(self, prompt_len: int) -> int:
+        """Servability check (same refusal contract as v1); paged
+        prefill has ONE chunk shape, so the 'bucket' is always
+        ``prefill_chunk``."""
+        if not 1 <= prompt_len <= self.max_prefill:
+            raise ValueError(
+                f"prompt length {prompt_len} outside servable range "
+                f"[1, {self.max_prefill}] (max_seq {self.max_seq} "
+                f"leaves one position for generation)"
+            )
+        return self.prefill_chunk
+
+    # -- host API ----------------------------------------------------------
+
+    def copy_block(self, src: int, dst: int) -> None:
+        """Device-side copy of one physical block (all layers, K and
+        V) — the copy-on-write primitive ``BlockManager
+        .ensure_writable`` calls.  One compile, scalar operands."""
+        self.pools = self._copy_jit()(
+            self.pools, jnp.int32(src), jnp.int32(dst)
+        )
+
+    def prefill(self, table_row, chunk_ids, start: int, q_len: int,
+                key, temperature):
+        """Run one prefill chunk; returns the sampled follow-on token
+        as an UN-READ device array (meaningful on the final chunk —
+        the caller's ``int()`` conversion is the TTFT fence, and
+        skipping it on non-final chunks keeps a long prompt's chunk
+        pipeline asynchronous).  ``chunk_ids`` may be shorter than
+        ``prefill_chunk``; it is zero-padded to the fixed chunk
+        shape."""
+        assert 1 <= q_len <= self.prefill_chunk
+        padded = np.zeros((self.prefill_chunk,), np.int32)
+        padded[:q_len] = np.asarray(chunk_ids, np.int32)[:q_len]
+        self.pools, tok = self._prefill_jit(temperature <= 0)(
+            self.model.params, self.pools,
+            jnp.asarray(table_row, jnp.int32),
+            jnp.asarray(padded),
+            jnp.int32(start), jnp.int32(q_len),
+            jnp.asarray(key, jnp.uint32),
+            jnp.float32(temperature),
+        )
+        return tok
+
+    def decode(self, tokens, lengths, keys, temps, tables=None,
+               active=None) -> np.ndarray:
+        """One decode step for all slots through the block tables
+        (host arrays in, host token ids [S] out)."""
+        assert tables is not None and active is not None, (
+            "paged decode needs the block tables and the active mask"
+        )
+        self.pools, nxt = self._decode_jit(
+            bool(np.all(np.asarray(temps) <= 0.0))
+        )(
+            self.model.params, self.pools,
+            jnp.asarray(tables, jnp.int32),
+            jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(lengths, jnp.int32),
+            jnp.asarray(keys, jnp.uint32),
+            jnp.asarray(temps, jnp.float32),
+            jnp.asarray(active, bool),
+        )
+        return np.asarray(nxt)
+
+    # -- accounting --------------------------------------------------------
+
+    def kv_cache_bytes(self) -> int:
+        """Total HBM the block pools occupy (trash block included)."""
+        return self.kv_bytes_per_block() * (
+            self.manager.allocator.n_blocks + 1
+        )
+
+    def kv_bytes_per_block(self) -> int:
+        m = self.model
+        itemsize = jnp.dtype(self._cdtype).itemsize
+        return (
+            2 * m.n_layers * m.n_kv_heads * self.block_size
+            * self._hd * itemsize
+        )
+
+    def kv_bytes_per_slot(self) -> int:
+        """HBM per admitted request at FULL table occupancy — the
+        worst case; the measured per-request figure is
+        ``kv_bytes_per_block() * blocks_owned`` (the bench reports
+        both)."""
+        return self.kv_bytes_per_block() * self.max_blocks
+
+    def _dummy_decode_args(self) -> tuple:
+        s = self.max_slots
+        return (
+            self.model.params, self.pools,
+            jnp.zeros((s, self.max_blocks), jnp.int32),
+            jnp.zeros((s,), jnp.int32), jnp.zeros((s,), jnp.int32),
+            jnp.zeros((s, 2), jnp.uint32), jnp.zeros((s,), jnp.float32),
+            jnp.zeros((s,), bool),
+        )
+
+    def non_decode_hlo_texts(self, greedy: bool = True) -> list[str]:
+        """Optimized HLO of the OTHER device executables a paged
+        serving run dispatches (the prefill chunk and the CoW block
+        copy) — subtract their ``trace_comm.hlo_instruction_names``
+        from a decode marker set before attributing a trace that
+        interleaves them: instruction names are unique per module
+        only, and e.g. the prefill module's ``fusion.1`` would match
+        a decode instruction of the same name."""
+        from theanompi_tpu.utils.trace_comm import compiled_hlo_text
+
+        pf = self._prefill_jit(greedy).lower(
+            self.model.params, self.pools,
+            jnp.zeros((self.max_blocks,), jnp.int32),
+            jnp.zeros((self.prefill_chunk,), jnp.int32),
+            jnp.int32(0), jnp.int32(1),
+            jnp.zeros((2,), jnp.uint32), jnp.float32(0.0),
+        )
+        cp = self._copy_jit().lower(
+            self.pools, jnp.int32(0), jnp.int32(0)
+        )
+        return [
+            compiled_hlo_text(pf.compile()),
+            compiled_hlo_text(cp.compile()),
+        ]
+
 
 def decoder_from_checkpoint(
     config: dict,
@@ -397,14 +918,16 @@ def decoder_from_checkpoint(
     *,
     mesh=None,
     devices=None,
+    paged: bool = False,
     **decoder_kw,
 ) -> LlamaDecoder:
     """The train → checkpoint → serve path in one call: build a
     ``Llama`` for the SERVING layout (``config['tp']`` etc.), restore
     weights through ``model.load`` — including sharded checkpoints
     and the validated/quarantine fallback path — and wrap it in a
-    ``LlamaDecoder``.  The checkpoint may come from any training
-    layout; npz and sharded formats both reload across layouts."""
+    decoder (``paged=True`` → :class:`PagedLlamaDecoder`).  The
+    checkpoint may come from any training layout; npz and sharded
+    formats both reload across layouts."""
     model = Llama(config)
     if mesh is None:
         mesh = make_mesh(
@@ -417,4 +940,5 @@ def decoder_from_checkpoint(
         raise FileNotFoundError(
             f"no loadable checkpoint under {directory!r}"
         )
-    return LlamaDecoder(model, **decoder_kw)
+    cls = PagedLlamaDecoder if paged else LlamaDecoder
+    return cls(model, **decoder_kw)

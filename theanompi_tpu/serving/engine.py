@@ -21,6 +21,25 @@ expires before a slot frees is shed on the next engine iteration
 (``start``/``stop`` — open-loop traffic).  Telemetry (per-request
 TTFT/TPOT, aggregate tokens/s, slot occupancy, queue depth) flows
 through ``utils.recorder.ServingRecorder``.
+
+Over a :class:`~theanompi_tpu.serving.decoder.PagedLlamaDecoder` the
+same loop additionally drives the paged-cache machinery (serving v2):
+
+- **admission** adopts radix-prefix-cached blocks (a shared system
+  prompt is prefilled ONCE), allocates table blocks for the rest,
+  and — when the pool is dry even after LRU eviction — either waits
+  (someone in flight will free blocks) or sheds LOUDLY with
+  ``finish_reason="no_blocks"`` (a structurally-too-large prompt
+  sheds at ``submit`` time);
+- **chunked prefill**: a long prompt prefills in fixed-size chunks,
+  at most ``prefill_chunks_per_step`` per engine iteration, with the
+  decode step for in-flight slots running BETWEEN chunks — a
+  2k-token arrival no longer stalls everyone's TPOT;
+- **copy-on-write / growth**: before every write position the engine
+  passes the ``ensure_writable`` gate (shared block → device-side
+  copy to a fresh one) and grows tables as decode crosses block
+  boundaries; a growth failure after eviction ends THAT request with
+  ``finish_reason="no_blocks"`` (its tokens so far are delivered).
 """
 
 from __future__ import annotations
@@ -34,6 +53,7 @@ import numpy as np
 
 import jax
 
+from theanompi_tpu.serving.blocks import OutOfBlocks
 from theanompi_tpu.serving.decoder import LlamaDecoder
 from theanompi_tpu.utils.recorder import ServingRecorder
 
@@ -54,8 +74,12 @@ class Result:
     """Terminal state of a request.  ``status``: ``"ok"`` (generated
     until EOS/max_tokens) or ``"shed"`` (admission control refused
     it; ``tokens`` is empty).  ``finish_reason``: ``"eos"``,
-    ``"max_tokens"``, ``"max_seq"`` when served; ``"queue_full"``,
-    ``"deadline"``, ``"prompt_too_long"``, ``"shutdown"`` when shed.
+    ``"max_tokens"``, ``"max_seq"``, or ``"no_blocks"`` (paged pool
+    ran dry mid-generation — the tokens emitted so far ARE returned)
+    when served; ``"queue_full"``, ``"deadline"``,
+    ``"prompt_too_long"``, ``"shutdown"``, ``"no_blocks"`` (prompt
+    structurally larger than the pool, or scarcity with nothing in
+    flight to wait on) when shed.
     """
 
     status: str
@@ -105,15 +129,23 @@ class _Entry:
 class _SlotState:
     __slots__ = (
         "entry", "generated", "first_tok_t", "last_tok_t", "prompt_len",
+        "state", "pf_pos", "n_prefix_hit",
     )
 
-    def __init__(self, entry: _Entry, prompt_len: int, first_tok: int):
+    def __init__(self, entry: _Entry, prompt_len: int,
+                 first_tok: int | None = None, *, state: str = "decode",
+                 pf_pos: int = 0, n_prefix_hit: int = 0):
         now = time.monotonic()
         self.entry = entry
-        self.generated = [first_tok]
-        self.first_tok_t = now
+        self.generated = [] if first_tok is None else [first_tok]
+        self.first_tok_t = now if first_tok is not None else None
         self.last_tok_t = now
         self.prompt_len = prompt_len
+        # paged lifecycle: "prefill" (chunks still running; pf_pos =
+        # next prompt position) → "decode"; v1 slots are born "decode"
+        self.state = state
+        self.pf_pos = pf_pos
+        self.n_prefix_hit = n_prefix_hit
 
 
 class Engine:
@@ -127,6 +159,9 @@ class Engine:
         default_deadline_s: float = 60.0,
         eos_id: int | None = None,
         recorder: ServingRecorder | None = None,
+        chunked_prefill: bool | None = None,
+        prefill_chunks_per_step: int = 1,
+        prefix_caching: bool = True,
     ):
         self.decoder = decoder
         self.queue_cap = int(queue_cap)
@@ -134,6 +169,33 @@ class Engine:
         self.eos_id = eos_id
         s = decoder.max_slots
         self.recorder = recorder or ServingRecorder(max_slots=s)
+
+        # paged-cache wiring (serving v2) — None/ignored over a v1
+        # slot-contiguous decoder
+        self._paged = bool(getattr(decoder, "paged", False))
+        self.chunked_prefill = (
+            bool(chunked_prefill) if chunked_prefill is not None
+            else self._paged
+        )
+        self.prefill_chunks_per_step = int(prefill_chunks_per_step)
+        if self.prefill_chunks_per_step < 1:
+            raise ValueError(
+                "prefill_chunks_per_step must be >= 1, got "
+                f"{self.prefill_chunks_per_step}: a prefilling slot "
+                "that advances zero chunks per step never finishes"
+            )
+        self._mgr = decoder.manager if self._paged else None
+        self._prefix = (
+            decoder.prefix_cache
+            if self._paged and prefix_caching else None
+        )
+        # eviction must see the decoder's cache even when THIS engine
+        # does no matching/inserting (prefix_caching=False): the cache
+        # is shared across engines over one decoder, and blocks another
+        # engine retained are reclaimable memory, not a shed reason
+        self._evictable = (
+            decoder.prefix_cache if self._paged else None
+        )
 
         self._lock = threading.Lock()
         self._queue: deque[_Entry] = deque()
@@ -143,6 +205,7 @@ class Engine:
         self._lengths = np.zeros((s,), np.int32)
         self._keys = np.zeros((s, 2), np.uint32)
         self._temps = np.zeros((s,), np.float32)
+        self._active = np.zeros((s,), bool)   # paged: decoding slots
 
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
@@ -170,15 +233,15 @@ class Engine:
         try:
             self.decoder.bucket_for(len(req.prompt))
         except ValueError:
-            entry.future._set(Result(
-                status="shed", finish_reason="prompt_too_long",
-                queued_s=0.0,
-            ))
-            self.recorder.record_request(
-                status="shed", finish_reason="prompt_too_long",
-                n_prompt=len(req.prompt), n_generated=0,
-            )
-            return entry.future
+            return self._shed_at_submit(entry, "prompt_too_long")
+        # paged: a prompt whose table would need more blocks than the
+        # WHOLE pool can never be admitted — shed now, loudly, instead
+        # of letting it rot in the queue until its deadline
+        if self._paged and (
+            self._mgr.blocks_for(len(req.prompt) + 1)
+            > self._mgr.allocator.n_blocks
+        ):
+            return self._shed_at_submit(entry, "no_blocks")
         with self._lock:
             # the shutdown check shares the enqueue's lock hold: an
             # entry appended here with _stop unset is guaranteed
@@ -194,13 +257,19 @@ class Engine:
             if reason is None:
                 self._queue.append(entry)
         if reason is not None:
-            entry.future._set(Result(
-                status="shed", finish_reason=reason, queued_s=0.0,
-            ))
-            self.recorder.record_request(
-                status="shed", finish_reason=reason,
-                n_prompt=len(req.prompt), n_generated=0,
-            )
+            return self._shed_at_submit(entry, reason)
+        return entry.future
+
+    def _shed_at_submit(self, entry: _Entry, reason: str):
+        """Resolve a request shed before it entered the queue (the
+        future resolves immediately; queued time is zero)."""
+        entry.future._set(Result(
+            status="shed", finish_reason=reason, queued_s=0.0,
+        ))
+        self.recorder.record_request(
+            status="shed", finish_reason=reason,
+            n_prompt=len(entry.request.prompt), n_generated=0,
+        )
         return entry.future
 
     def queue_depth(self) -> int:
@@ -249,6 +318,11 @@ class Engine:
         self._temps[slot] = 0.0
         self._tokens[slot] = 0
         self._lengths[slot] = 0
+        self._active[slot] = False
+        if self._paged:
+            # release the table's block references; prefix-cached
+            # blocks survive under the cache's own reference
+            self._mgr.free_slot(slot)
         n = len(st.generated)
         tpot = (
             (st.last_tok_t - st.first_tok_t) / (n - 1) if n > 1 else None
@@ -266,11 +340,186 @@ class Engine:
             status="ok", finish_reason=reason,
             n_prompt=st.prompt_len, n_generated=n,
             ttft_s=ttft, tpot_s=tpot, e2e_s=e2e,
+            n_prefix_hit=st.n_prefix_hit,
         )
+
+    # -- paged-cache admission / prefill (serving v2) ----------------------
+
+    def _try_blocks(self, n_needed: int) -> bool:
+        """Free-list headroom for ``n_needed`` fresh blocks, evicting
+        LRU prefix-cache leaves when short.  Host-side only — no
+        allocation happens here."""
+        alloc = self._mgr.allocator
+        if alloc.blocks_free >= n_needed:
+            return True
+        if self._evictable is not None:
+            self._evictable.evict(n_needed - alloc.blocks_free)
+        return alloc.blocks_free >= n_needed
+
+    def _admit_paged(self, now: float) -> None:
+        for slot in range(self.decoder.max_slots):
+            if self._slots[slot] is not None:
+                continue
+            with self._lock:
+                entry = self._queue.popleft() if self._queue else None
+            if entry is None:
+                return
+            req = entry.request
+            plen = len(req.prompt)
+            # adopt the longest radix-cached prefix (capped so at
+            # least one prompt token prefills — its logits seed the
+            # first sampled token); the match hands us one reference
+            # per adopted block, which assign() transfers to the table
+            matched, adopted = (
+                self._prefix.match(req.prompt, plen - 1)
+                if self._prefix is not None else (0, [])
+            )
+            n_total = self._mgr.blocks_for(plen + 1)
+            if not self._try_blocks(n_total - len(adopted)):
+                self._mgr.release_adopted(adopted)
+                if self._prefix is not None:
+                    # abandoned adoption: hit-rate counters must only
+                    # reflect admissions, not per-step retries
+                    self._prefix.unrecord_match(matched)
+                if not any(s is not None for s in self._slots):
+                    # nothing in flight will EVER free a block: shed
+                    # loudly instead of deadlocking the queue head
+                    self._shed(entry, "no_blocks", now)
+                    continue
+                with self._lock:
+                    self._queue.appendleft(entry)   # keep FIFO order
+                return
+            self._mgr.assign(slot, adopted, n_total)
+            self._slots[slot] = _SlotState(
+                entry, plen, state="prefill", pf_pos=matched,
+                n_prefix_hit=matched,
+            )
+            self._keys[slot] = np.asarray(
+                jax.random.PRNGKey(req.seed), np.uint32
+            )
+            if not self.chunked_prefill:
+                # monolithic behavior: all chunks back-to-back, the
+                # request rides the very next decode step
+                self._advance_prefill_slot(slot, limit=None)
+
+    def _cow_gate(self, slot: int, bidx: int) -> None:
+        """``ensure_writable`` with eviction headroom: a CoW needs a
+        fresh block BEYOND the admission reservation (one per shared
+        block being written), so give the allocator LRU-evicted room
+        first.  Raises ``OutOfBlocks`` when the pool is truly dry."""
+        bid = int(self._mgr.tables[slot, bidx])
+        if self._mgr.allocator.refcount(bid) > 1:
+            self._try_blocks(1)
+        self._mgr.ensure_writable(slot, bidx, self.decoder.copy_block)
+
+    def _abort_prefill(self, slot: int, reason: str) -> None:
+        """A mid-prefill slot cannot deliver tokens: resolve its
+        future as shed (never a hang) and release its blocks."""
+        st = self._slots[slot]
+        self._slots[slot] = None
+        self._mgr.free_slot(slot)
+        self._shed(st.entry, reason, time.monotonic())
+
+    def _advance_prefill_slot(self, slot: int,
+                              limit: int | None) -> int:
+        """Run up to ``limit`` prefill chunks (None = to completion)
+        for one mid-prefill slot, passing every write block through
+        the copy-on-write gate first.  Returns the number of chunks
+        run — the caller's per-iteration budget accounting."""
+        st = self._slots[slot]
+        req = st.entry.request
+        dec = self.decoder
+        bs = dec.block_size
+        done = 0
+        tok = None
+        while st.pf_pos < st.prompt_len and (
+            limit is None or done < limit
+        ):
+            c = min(dec.prefill_chunk, st.prompt_len - st.pf_pos)
+            try:
+                for bidx in range(
+                    st.pf_pos // bs, (st.pf_pos + c - 1) // bs + 1
+                ):
+                    self._cow_gate(slot, bidx)
+            except OutOfBlocks:
+                self._abort_prefill(slot, "no_blocks")
+                return done
+            tok = dec.prefill(
+                self._mgr.tables[slot],
+                req.prompt[st.pf_pos: st.pf_pos + c],
+                st.pf_pos, c, self._keys[slot], req.temperature,
+            )
+            st.pf_pos += c
+            done += 1
+        if st.pf_pos >= st.prompt_len:
+            self._finish_prefill(slot, tok)
+        return done
+
+    def _finish_prefill(self, slot: int, first) -> None:
+        """Final chunk done: record TTFT, publish the prompt's blocks
+        to the radix cache (so the NEXT request with this prefix
+        adopts them instead of re-prefilling), arm the decode
+        mirrors, and apply the same first-token eviction rules as
+        v1."""
+        st = self._slots[slot]
+        req = st.entry.request
+        # the int() is the device fence: non-final chunks return
+        # un-read device tokens so chunk dispatch stays async — TTFT
+        # is stamped only after the final chunk's token is real
+        first = int(first)
+        now = time.monotonic()
+        st.state = "decode"
+        st.generated = [first]
+        st.first_tok_t = now
+        st.last_tok_t = now
+        # the partial tail block is cached too: its extra reference
+        # forces ONE CoW block copy when this slot's decode writes
+        # into it — the bounded price of partial-prefix adoption
+        # (match()'s best-common-prefix arm), which is where most of
+        # the hit tokens come from when suffixes are short
+        if self._prefix is not None:
+            self._prefix.insert(
+                req.prompt,
+                self._mgr.slot_blocks(
+                    slot, self._mgr.blocks_for(st.prompt_len)
+                ),
+            )
+        self._tokens[slot] = first
+        self._lengths[slot] = st.prompt_len
+        self._temps[slot] = req.temperature
+        self._active[slot] = True
+        if self.eos_id is not None and first == self.eos_id:
+            self._finish(slot, "eos")
+        elif req.max_tokens <= 1:
+            self._finish(slot, "max_tokens")
+
+    def _prepare_decode_writes(self) -> None:
+        """Before each paged decode step: grow every decoding slot's
+        table across block boundaries and pass its write block
+        through the CoW gate.  A pool dry even after eviction ends
+        that request loudly (``no_blocks``) with the tokens it has."""
+        dec = self.decoder
+        bs = dec.block_size
+        for slot, st in enumerate(self._slots):
+            if st is None or st.state != "decode":
+                continue
+            bidx = int(self._lengths[slot]) // bs
+            try:
+                need = bidx + 1 - self._mgr.n_owned[slot]
+                if need > 0:
+                    self._try_blocks(need)   # best-effort LRU evict
+                # grow/CoW allocate through the allocator, which
+                # counts the OOM and raises with its state attached
+                self._mgr.grow(slot, bidx)
+                self._cow_gate(slot, bidx)
+            except OutOfBlocks:
+                self._finish(slot, "no_blocks")
 
     def _admit(self, now: float) -> None:
         """Fill free slots from the queue head — a prefill each, so
         the admitted request rides the very next decode step."""
+        if self._paged:
+            return self._admit_paged(now)
         for slot in range(self.decoder.max_slots):
             if self._slots[slot] is not None:
                 continue
@@ -295,14 +544,29 @@ class Engine:
             elif req.max_tokens <= 1:
                 self._finish(slot, "max_tokens")
 
-    def _decode_once(self) -> int:
-        nxt = self.decoder.decode(
-            self._tokens, self._lengths, self._keys, self._temps
+    def _decoding_slots(self) -> int:
+        return sum(
+            st is not None and st.state == "decode"
+            for st in self._slots
         )
+
+    def _decode_once(self) -> int:
+        if self._paged:
+            self._prepare_decode_writes()
+            if not self._decoding_slots():
+                return 0
+            nxt = self.decoder.decode(
+                self._tokens, self._lengths, self._keys, self._temps,
+                self._mgr.tables, self._active,
+            )
+        else:
+            nxt = self.decoder.decode(
+                self._tokens, self._lengths, self._keys, self._temps
+            )
         now = time.monotonic()
         emitted = 0
         for slot, st in enumerate(self._slots):
-            if st is None:
+            if st is None or st.state != "decode":
                 continue
             self._lengths[slot] += 1  # last token now lives in cache
             tok = int(nxt[slot])
@@ -322,22 +586,78 @@ class Engine:
         return emitted
 
     def step(self) -> bool:
-        """One engine iteration (shed → admit → decode).  Returns
-        whether any device work ran — the loop's idle signal."""
+        """One engine iteration (shed → admit → [prefill chunks] →
+        decode).  Returns whether any work remains in flight — the
+        loop's idle signal.  Under chunked prefill, at most
+        ``prefill_chunks_per_step`` chunks run here IN TOTAL across
+        all mid-prefill slots while the decode step below keeps the
+        in-flight slots' TPOT moving."""
         now = time.monotonic()
         self._sweep_deadlines(now)
         self._admit(now)
+        if self._paged and self.chunked_prefill:
+            # ONE budget across all prefilling slots (spent in slot
+            # order): the knob bounds total prefill work between
+            # consecutive decode steps, so in-flight TPOT stall does
+            # not scale with how many long prompts arrived together
+            budget = self.prefill_chunks_per_step
+            for slot, st in enumerate(self._slots):
+                if budget <= 0:
+                    break
+                if st is not None and st.state == "prefill":
+                    budget -= self._advance_prefill_slot(
+                        slot, limit=budget
+                    )
         if not any(s is not None for s in self._slots):
             return False
+        if self._paged and not self._decoding_slots():
+            # prefills advanced; more work next step.  No decode step
+            # to record, but the pool peak may be NOW (fresh admits +
+            # CoW bursts) — keep the gauges honest
+            alloc = self._mgr.allocator
+            self.recorder.record_block_gauges(
+                blocks_in_use=alloc.blocks_in_use,
+                blocks_free=alloc.blocks_free,
+            )
+            return True
         t0 = time.monotonic()
         emitted = self._decode_once()
+        gauges = {}
+        if self._paged:
+            alloc = self._mgr.allocator
+            gauges = dict(
+                blocks_in_use=alloc.blocks_in_use,
+                blocks_free=alloc.blocks_free,
+            )
         self.recorder.record_step(
             active_slots=emitted,  # the batch that actually decoded
             queue_depth=self.queue_depth(),
             dt_s=time.monotonic() - t0,
             tokens=emitted,
+            **gauges,
         )
         return True
+
+    def n_prefilling(self) -> int:
+        """Slots still mid-prefill — 0 means every in-flight request
+        is decoding, so subsequent ``step()`` calls dispatch ONLY the
+        decode executable (the window the bench's decode-cost
+        attribution traces: instruction names are module-unique, not
+        trace-unique, so the trace must not interleave executables)."""
+        return sum(
+            1 for s in self._slots
+            if s is not None and s.state == "prefill"
+        )
+
+    def paging_stats(self) -> dict | None:
+        """Allocator + prefix-cache counters (None over a v1
+        decoder) — the bench row's block-accounting datum."""
+        if not self._paged:
+            return None
+        out = {"allocator": self._mgr.allocator.stats()}
+        if self._prefix is not None:
+            out["prefix_cache"] = self._prefix.stats()
+        return out
 
     def run_until_idle(self) -> None:
         """Drive the loop inline until no request is queued or in

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import json
 import time
+from collections import Counter
 from pathlib import Path
 from typing import Optional
 
@@ -301,16 +302,22 @@ class ServingRecorder:
     Per-request fields (``record_request``): ``status`` "ok"/"shed",
     ``finish_reason``, prompt/generated token counts, ``ttft_s``
     (submit → first token), ``tpot_s`` (mean inter-token seconds
-    after the first), ``queued_s``, ``e2e_s``.
+    after the first), ``queued_s``, ``e2e_s``, ``n_prefix_hit``
+    (prompt tokens adopted from the radix prefix cache — 0 over the
+    v1 slot-contiguous decoder).
 
     Per-step fields (``record_step``): slots that decoded, queue
-    depth at the step, step seconds, tokens emitted.
+    depth at the step, step seconds, tokens emitted, and — paged
+    serving only — the block gauges ``blocks_in_use``/``blocks_free``
+    at the step.
     """
 
     def __init__(self, max_slots: int = 1):
         self.max_slots = int(max_slots)
         self.requests: list[dict] = []
         self.steps: list[dict] = []
+        self.blocks_in_use_max: int | None = None
+        self.blocks_free_min: int | None = None
 
     def record_request(
         self,
@@ -323,6 +330,7 @@ class ServingRecorder:
         tpot_s: float | None = None,
         queued_s: float | None = None,
         e2e_s: float | None = None,
+        n_prefix_hit: int = 0,
     ) -> None:
         self.requests.append({
             "status": status,
@@ -333,6 +341,7 @@ class ServingRecorder:
             "tpot_s": tpot_s,
             "queued_s": queued_s,
             "e2e_s": e2e_s,
+            "n_prefix_hit": int(n_prefix_hit),
         })
 
     def record_step(
@@ -342,13 +351,42 @@ class ServingRecorder:
         queue_depth: int,
         dt_s: float,
         tokens: int,
+        blocks_in_use: int | None = None,
+        blocks_free: int | None = None,
     ) -> None:
         self.steps.append({
             "active_slots": int(active_slots),
             "queue_depth": int(queue_depth),
             "dt_s": float(dt_s),
             "tokens": int(tokens),
+            "blocks_in_use": blocks_in_use,
+            "blocks_free": blocks_free,
         })
+        self.record_block_gauges(
+            blocks_in_use=blocks_in_use, blocks_free=blocks_free
+        )
+
+    def record_block_gauges(
+        self,
+        *,
+        blocks_in_use: int | None = None,
+        blocks_free: int | None = None,
+    ) -> None:
+        """Fold one pool observation into the running extremes —
+        callable OUTSIDE decode steps too, because a prefill-only
+        engine iteration (large admit, CoW burst, mid-prefill abort)
+        can hit the allocation peak with no decode step to attach
+        it to."""
+        if blocks_in_use is not None:
+            self.blocks_in_use_max = (
+                int(blocks_in_use) if self.blocks_in_use_max is None
+                else max(self.blocks_in_use_max, int(blocks_in_use))
+            )
+        if blocks_free is not None:
+            self.blocks_free_min = (
+                int(blocks_free) if self.blocks_free_min is None
+                else min(self.blocks_free_min, int(blocks_free))
+            )
 
     def summary(self) -> dict:
         """One dict the bench row emits: throughput, latency
@@ -365,11 +403,12 @@ class ServingRecorder:
             if decode_s else None
         )
         depths = [s["queue_depth"] for s in self.steps]
-        shed_reasons: dict[str, int] = {}
-        for r in shed:
-            shed_reasons[r["finish_reason"]] = (
-                shed_reasons.get(r["finish_reason"], 0) + 1
-            )
+        shed_reasons = dict(Counter(r["finish_reason"] for r in shed))
+        finish_reasons = dict(Counter(r["finish_reason"] for r in ok))
+        # paged-cache telemetry: prefix-cache hit rate over served
+        # prompt tokens, and the block gauges' extremes
+        hit_tokens = sum(r.get("n_prefix_hit", 0) for r in ok)
+        prompt_tokens = sum(r["n_prompt"] for r in ok)
         return {
             "n_requests": len(self.requests),
             "n_completed": len(ok),
@@ -390,4 +429,11 @@ class ServingRecorder:
                 float(np.mean(depths)) if depths else None
             ),
             "queue_depth_max": max(depths) if depths else None,
+            "finish_reasons": finish_reasons,
+            "prefix_hit_tokens": hit_tokens,
+            "prefix_hit_rate": (
+                hit_tokens / prompt_tokens if prompt_tokens else None
+            ),
+            "blocks_in_use_max": self.blocks_in_use_max,
+            "blocks_free_min": self.blocks_free_min,
         }

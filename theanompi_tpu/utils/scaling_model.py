@@ -475,6 +475,9 @@ def serving_roofline(
     param_dtype_bytes: int = 2,
     kv_dtype_bytes: int = 2,
     chip: ChipSpec = V5E,
+    max_seq: int | None = None,
+    block_size: int | None = None,
+    prefix_hit_frac: float = 0.0,
 ) -> dict:
     """HBM-bandwidth roofline for the serving DECODE step.
 
@@ -495,6 +498,23 @@ def serving_roofline(
     bench row's measured tokens/s at each offered load is the
     CPU-mesh analogue of this curve; on real v5e the prediction is
     checkable against the datasheet 819 GB/s.
+
+    Paged extensions (serving v2, ``serving/blocks.py``), emitted
+    when ``block_size`` is given:
+
+    - ``paged_kv_bytes_per_slot`` — HBM a request at ``context``
+      tokens actually HOLDS under paging (its blocks, rounded up to
+      ``block_size``), vs the contiguous layout's
+      ``contiguous_kv_bytes_per_slot`` = ``max_seq`` rows regardless
+      of use; ``paged_hbm_saving`` is their ratio and
+      ``max_slots_paged`` / ``max_slots_contiguous`` the concurrent
+      requests one chip's HBM then carries — the capacity win paging
+      buys (decode BANDWIDTH is unchanged: both layouts read the
+      same ``context`` tokens per step).
+    - ``prefix_hit_frac`` (radix cache, ``serving/prefix_cache.py``):
+      fraction of prompt tokens adopted instead of prefilled.
+      Prefill is compute-bound, so predicted TTFT scales by
+      ``(1 - hit)``: ``prefix_ttft_speedup`` = 1 / (1 - hit).
     """
     p_bytes = llama_param_count(cfg) * param_dtype_bytes / tp
     kv_tok = llama_kv_bytes_per_token(
@@ -503,7 +523,7 @@ def serving_roofline(
     kv_slot = kv_tok * context
     bytes_per_step = p_bytes + batch * kv_slot
     t_step = bytes_per_step / chip.hbm_bw
-    return {
+    out = {
         "param_bytes_per_chip": p_bytes,
         "kv_bytes_per_slot": kv_slot,
         "bytes_per_step": bytes_per_step,
@@ -514,6 +534,22 @@ def serving_roofline(
         "param_read_frac": p_bytes / bytes_per_step,
         "crossover_batch": p_bytes / kv_slot if kv_slot else None,
     }
+    if block_size is not None:
+        blocks_held = -(-(context + 1) // int(block_size))
+        paged_slot = kv_tok * blocks_held * int(block_size)
+        out["paged_kv_bytes_per_slot"] = paged_slot
+        hbm_for_kv = chip.hbm_bytes - p_bytes
+        out["max_slots_paged"] = int(hbm_for_kv // paged_slot)
+        if max_seq is not None:
+            contig_slot = kv_tok * int(max_seq)
+            out["contiguous_kv_bytes_per_slot"] = contig_slot
+            out["paged_hbm_saving"] = contig_slot / paged_slot
+            out["max_slots_contiguous"] = int(hbm_for_kv // contig_slot)
+    if prefix_hit_frac:
+        assert 0.0 <= prefix_hit_frac < 1.0, prefix_hit_frac
+        out["prefix_hit_frac"] = prefix_hit_frac
+        out["prefix_ttft_speedup"] = 1.0 / (1.0 - prefix_hit_frac)
+    return out
 
 
 def llama_step_flops(cfg: dict, batch: int, seq_len: int | None = None,

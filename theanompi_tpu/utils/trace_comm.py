@@ -142,7 +142,13 @@ def scope_op_names(hlo_text: str,
     profiler emits for those instructions.  Names from inside fused
     computations are included too; they never collide with top-level
     names (HLO instruction names are module-unique), so the extras
-    are harmless."""
+    are harmless.
+
+    Module-unique is NOT trace-unique: every executable has its own
+    ``fusion.1``.  When the traced run interleaves several
+    executables, subtract ``hlo_instruction_names`` of the OTHER
+    modules from the returned set, or their events get attributed
+    here."""
     global _HLO_INSTR_RE
     import re
 
@@ -156,6 +162,21 @@ def scope_op_names(hlo_text: str,
         if any(mk in op_name for mk in markers):
             out.add(name)
     return out
+
+
+def hlo_instruction_names(hlo_text: str) -> set[str]:
+    """EVERY instruction name (no ``%``) in ``hlo_text``, op_name
+    metadata or not — the subtrahend for cross-module collision
+    filtering (see ``scope_op_names``): profiler events carry the
+    bare instruction name, and an unrelated executable's
+    ``fusion.1`` would otherwise count toward a marker set extracted
+    from a different module."""
+    import re
+
+    return {
+        m.group(1)
+        for m in re.finditer(r"%([\w.\-]+)\s*=", hlo_text)
+    }
 
 
 def compiled_hlo_text(compiled) -> str:
