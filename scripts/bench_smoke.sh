@@ -17,6 +17,11 @@
 #    asserts prefix hit rate > 0, every request completes, token
 #    accounting is exact, and the decode executable never recompiled
 #    (the in-child compile-counter assertions also gate this).
+# 4. serving_fleet: the fleet router in smoke shape — 2 replica
+#    PROCESSES behind the TCP wire, one carrying a
+#    TM_FAULT_AT=1:4:die_replica drill that kills it mid-generation;
+#    asserts every request completes with exact token accounting and
+#    at least one failover requeue was recorded (zero lost futures).
 #
 # Usage: bash scripts/bench_smoke.sh
 
@@ -74,4 +79,22 @@ if arm["tokens_completed"] != 4 * 8:
 if row["n_decode_compiles"] > 2 or row["n_prefill_compiles"] > 2:
     sys.exit("bench_smoke: paged executables recompiled: %s" % row)
 print("bench_smoke: serving_paged OK")
+'
+
+out=$(TM_SERVING_SMOKE=1 TM_BENCH_MODEL=serving_fleet python bench.py)
+printf '%s\n' "$out" | python -c '
+import json, sys
+row = json.loads(sys.stdin.readline())
+arm = row["arms"]["kill_one_of_2"]
+print("fleet tokens/s", arm.get("agg_tokens_per_sec_wall"),
+      "requeues", arm.get("n_requeues"),
+      "failovers", arm.get("n_failovers"))
+if not arm["all_ok"] or arm["n_completed"] != 6:
+    sys.exit("bench_smoke: fleet kill arm did not complete all 6 "
+             "requests: %s" % arm)
+if arm["tokens_completed"] != 6 * 8:
+    sys.exit("bench_smoke: fleet token accounting off: %s" % arm)
+if not arm["n_requeues"] >= 1:
+    sys.exit("bench_smoke: fleet kill arm recorded no requeue: %s" % arm)
+print("bench_smoke: serving_fleet OK")
 '

@@ -29,6 +29,7 @@ counts stay small (one exchange per tau local steps).
 from __future__ import annotations
 
 import pickle
+import select
 import socket
 import struct
 import threading
@@ -46,9 +47,36 @@ _LEN = struct.Struct(">Q")
 _WIRE_CHUNK = 4 << 20  # stream granularity: bounds per-write buffers
 
 
-def _send(sock: socket.socket, obj) -> None:
+def _send(sock: socket.socket, obj, timeout_s: float | None = None) -> None:
     data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(_LEN.pack(len(data)) + data)
+    payload = _LEN.pack(len(data)) + data
+    _nb = getattr(socket, "MSG_DONTWAIT", None)
+    if timeout_s is None or _nb is None:
+        sock.sendall(payload)
+        return
+    # Deadline-bounded send: a peer that stops reading leaves sendall
+    # blocked forever on a full buffer.  select + MSG_DONTWAIT sends
+    # — per-call non-blocking, so a plain blocking send can't wedge
+    # on a partially-full buffer and the fd itself stays blocking
+    # for a concurrent reader thread recv'ing on the same socket.
+    # The caller must be the socket's only writer.
+    deadline = time.monotonic() + timeout_s
+    mv = memoryview(payload)
+    off = 0
+    while off < len(mv):
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise socket.timeout(
+                f"send_frame: {len(mv) - off} bytes unsent "
+                f"after {timeout_s}s (peer not reading)"
+            )
+        _, writable, _ = select.select([], [sock], [], remaining)
+        if not writable:
+            continue
+        try:
+            off += sock.send(mv[off:], _nb)
+        except BlockingIOError:
+            continue    # raced the buffer; select again
 
 
 def _recv(sock: socket.socket):
@@ -65,6 +93,14 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
             raise ConnectionError("peer closed")
         buf.extend(chunk)
     return bytes(buf)
+
+
+#: public aliases for the length-prefixed pickle control frame — the
+#: ONE wire idiom of the repo.  The serving fleet's replica protocol
+#: (``serving/replica.py``) rides the same frames as the EASGD/GoSGD
+#: center exchange, so there is exactly one framing to harden.
+send_frame = _send
+recv_frame = _recv
 
 
 # -- streamed array wire ----------------------------------------------------

@@ -14,6 +14,16 @@ traffic" workload): KV-cache decode for Llama + a slot-based engine.
   ``Engine.submit()`` front-end with admission control (queue cap +
   per-request deadlines + out-of-blocks accounting → load-shed
   results, never hangs) and chunked prefill interleaved with decode.
+- ``replica`` — fleet unit: one engine behind a health-stamped owner
+  loop, in-process (``InProcessReplica``) or in another process over
+  the center-server TCP frames (``ReplicaServer`` /
+  ``TCPReplicaClient``).
+- ``router`` — fleet front-end: ``Router`` spreads requests over N
+  replicas (round-robin / least-loaded / prefix-affinity consistent
+  hashing), watches heartbeats supervisor-style, requeues a failed
+  replica's queued AND in-flight requests to healthy members (every
+  future still resolves), and aggregates telemetry through
+  ``utils.recorder.FleetRecorder``.
 
 See docs/SERVING.md for lifecycle, knobs and telemetry.
 """
@@ -36,18 +46,36 @@ from theanompi_tpu.serving.engine import (
     ServingFuture,
 )
 from theanompi_tpu.serving.prefix_cache import PrefixCache
+from theanompi_tpu.serving.replica import (
+    InProcessReplica,
+    ReplicaServer,
+    TCPReplicaClient,
+)
+from theanompi_tpu.serving.router import (
+    POLICIES,
+    ConsistentHashRing,
+    Router,
+    prefix_affinity_key,
+)
 
 __all__ = [
     "BlockAllocator",
     "BlockManager",
+    "ConsistentHashRing",
     "Engine",
+    "InProcessReplica",
     "LlamaDecoder",
     "OutOfBlocks",
+    "POLICIES",
     "PagedLlamaDecoder",
     "PrefixCache",
+    "ReplicaServer",
     "Request",
     "Result",
+    "Router",
     "ServingFuture",
+    "TCPReplicaClient",
     "decoder_from_checkpoint",
     "default_prefill_buckets",
+    "prefix_affinity_key",
 ]

@@ -97,10 +97,30 @@ class ServingFuture:
     def __init__(self):
         self._event = threading.Event()
         self._result: Result | None = None
+        self._cb_lock = threading.Lock()
+        self._callbacks: list = []
 
     def _set(self, result: Result) -> None:
-        self._result = result
-        self._event.set()
+        with self._cb_lock:
+            if self._event.is_set():
+                return  # first resolution wins (fleet requeue dedup)
+            self._result = result
+            self._event.set()
+            callbacks, self._callbacks = self._callbacks, []
+        for fn in callbacks:
+            fn(result)
+
+    def add_done_callback(self, fn) -> None:
+        """Run ``fn(result)`` when the future resolves — immediately
+        if it already has.  Callbacks run on the resolving thread
+        (the engine loop / a wire reader), so keep them cheap; this
+        is how the fleet router learns of completions without a
+        waiter thread per request."""
+        with self._cb_lock:
+            if not self._event.is_set():
+                self._callbacks.append(fn)
+                return
+        fn(self._result)
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -685,6 +705,36 @@ class Engine:
             target=_loop, name="tm-serving-engine", daemon=True
         )
         self._thread.start()
+
+    def abandon_all(self, reason: str = "restart") -> int:
+        """Resolve EVERY queued and in-flight request as shed and
+        free their slots (and paged blocks) — the fleet's
+        replica-restart hook.  A replica whose loop died mid-flight
+        has its pending requests requeued elsewhere by the router,
+        but their ENGINE-side futures (and their slots' blocks) must
+        still be released, never dangle.  Call only with the engine
+        loop stopped; returns how many requests were abandoned."""
+        now = time.monotonic()
+        with self._lock:
+            residual = list(self._queue)
+            self._queue.clear()
+        n = 0
+        for entry in residual:
+            self._shed(entry, reason, now)
+            n += 1
+        for slot, st in enumerate(self._slots):
+            if st is None:
+                continue
+            self._slots[slot] = None
+            self._temps[slot] = 0.0
+            self._tokens[slot] = 0
+            self._lengths[slot] = 0
+            self._active[slot] = False
+            if self._paged:
+                self._mgr.free_slot(slot)
+            self._shed(st.entry, reason, now)
+            n += 1
+        return n
 
     def stop(self) -> None:
         """Stop the background loop, draining work submitted BEFORE

@@ -1,0 +1,609 @@
+"""Fleet-scale serving: a front-end router over N engine replicas.
+
+One ``Engine`` is one mesh; heavy traffic needs many.  The
+:class:`Router` spreads requests over replicas (in-process or TCP —
+``serving/replica.py``) with three pluggable dispatch policies,
+health-checked membership, and in-flight failover:
+
+**Policies** (``policy=``):
+
+- ``"round_robin"`` — cycle the healthy members (the baseline).
+- ``"least_loaded"`` — min ``replica.load()`` (queue depth + slot
+  occupancy, the ``ServingRecorder``-visible load scalar), ties
+  broken DETERMINISTICALLY to the lowest member index.
+- ``"prefix_affinity"`` — consistent hash on the prompt's
+  BLOCK-ALIGNED prefix (``len(prompt) // affinity_block *
+  affinity_block`` tokens, at least one block's worth): requests
+  sharing a system prompt land on the SAME replica, so its radix
+  prefix cache (PR 6) serves them all from one prefill.  The hash
+  ring holds every member and the lookup walks it skipping
+  unhealthy/backpressured ones, so membership changes only remap
+  the keys of the changed member — the consistent-hash stability
+  property under test.
+
+**Membership** (supervisor-style, ``utils/supervisor.py`` semantics):
+a monitor thread watches each replica's heartbeat; liveness is a
+FRESH stamp (never a progress comparison), with ``startup_grace_s``
+before the first beat and ``stall_timeout_s`` after.  Stamps land at
+engine-ITERATION boundaries, so ``stall_timeout_s`` must exceed the
+longest single dispatch a healthy replica performs — in practice the
+longest XLA compile (a cold prefill bucket): warm the executables
+before registering a replica, or keep the default generous.  A
+too-tight timeout is SAFE but wasteful: the "stalled" replica's
+requests are requeued (duplicated work, first completion wins) and
+it rejoins on its next fresh beat.  A stalled or
+dead replica goes UNHEALTHY: its queued and in-flight requests are
+requeued to healthy members (dedup on request id + dispatch
+generation — a late result from the "dead" replica can never double-
+resolve a future, and a requeued duplicate's first completion wins).
+Fresh beats from a recovered or relaunched replica REJOIN it
+automatically.
+
+**Admission** generalizes the per-request deadline machinery
+fleet-wide: ``fleet_queue_cap`` bounds incomplete admitted requests
+(shed ``"queue_full"`` at submit past it), ``replica_queue_cap`` is
+per-replica backpressure (a saturated member is skipped; if every
+member is saturated the request waits at the ROUTER and its deadline
+keeps running), and a request whose deadline expires undelivered —
+including across requeues, each redispatch carries the REMAINING
+budget — sheds ``"deadline"``.  ``max_requeues`` bounds failover
+bouncing (then ``"failover"``).  Every ``submit()`` future resolves
+with a terminal ``finish_reason``; the engine-level "never hangs"
+guarantee extends to the fleet.
+
+**Observability**: a ``utils.recorder.FleetRecorder`` records every
+terminal result router-side (fleet TTFT/TPOT percentiles survive
+replica death) plus requeue/failover/rejoin counters, and merges
+per-replica ``ServingRecorder`` states for occupancy/hit-rate/rate
+breakdowns (``Router.fleet_summary``).
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import itertools
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from theanompi_tpu.serving.engine import (
+    Request,
+    Result,
+    ServingFuture,
+)
+from theanompi_tpu.utils.recorder import FleetRecorder
+
+#: replica-side shed reasons that mean "this replica abandoned the
+#: request without serving it" — the router REQUEUES these instead of
+#: propagating them: restart() sheds a dead loop's engine futures, a
+#: TCP submit into a dying socket resolves "replica_dead", a stopping
+#: replica sheds "shutdown", and an engine whose own queue filled
+#: between the router's load probe and the submit sheds "queue_full"
+#: (another member probably has room; ``max_requeues`` bounds the
+#: bounce either way, ending in a terminal "failover" shed)
+_REQUEUE_REASONS = frozenset(
+    {"restart", "replica_dead", "shutdown", "queue_full"}
+)
+
+POLICIES = ("round_robin", "least_loaded", "prefix_affinity")
+
+
+def prefix_affinity_key(prompt, block: int) -> bytes:
+    """Stable digest of the prompt's block-aligned prefix.  Aligning
+    DOWN to the block grid means prompts differing only inside their
+    final partial block still share a key — exactly the tokens the
+    radix cache can share (block-granularity adoption); prompts
+    shorter than one block key on their full length.  sha1, not
+    ``hash()``: the mapping must agree across processes and runs."""
+    n = len(prompt)
+    aligned = max(min(n, block), n // block * block)
+    # one buffer, one update — '<i8' pins the byte stream (8-byte
+    # little-endian signed, the cross-process contract) regardless
+    # of host endianness
+    buf = np.asarray(
+        list(itertools.islice(prompt, aligned)), dtype="<i8"
+    ).tobytes()
+    return hashlib.sha1(buf).digest()
+
+
+class ConsistentHashRing:
+    """Classic consistent hashing: each node owns ``n_vnodes``
+    pseudo-random points on a 64-bit ring; a key maps to the first
+    node point at or after its digest (wrapping).  Removing a node
+    only remaps keys that mapped to ITS points; ``lookup`` takes a
+    skip-predicate so unhealthy/backpressured nodes are walked past
+    without mutating the ring (their keys come back when they do)."""
+
+    def __init__(self, n_vnodes: int = 64):
+        self.n_vnodes = int(n_vnodes)
+        self._points: list[tuple[int, str]] = []
+
+    def add(self, node: str) -> None:
+        for v in range(self.n_vnodes):
+            digest = hashlib.sha1(
+                f"{node}#{v}".encode()
+            ).digest()[:8]
+            self._points.append(
+                (int.from_bytes(digest, "big"), str(node))
+            )
+        self._points.sort()
+
+    def remove(self, node: str) -> None:
+        self._points = [
+            p for p in self._points if p[1] != str(node)
+        ]
+
+    def nodes(self) -> set:
+        return {n for _, n in self._points}
+
+    def lookup(self, key: bytes, skip=None) -> str | None:
+        """First acceptable node clockwise of ``key``'s point (None
+        when the ring is empty or everything is skipped)."""
+        if not self._points:
+            return None
+        h = int.from_bytes(hashlib.sha1(key).digest()[:8], "big")
+        i = bisect.bisect_left(self._points, (h, ""))
+        seen: set = set()
+        for off in range(len(self._points)):
+            _, node = self._points[(i + off) % len(self._points)]
+            if node in seen:
+                continue
+            seen.add(node)
+            if skip is None or not skip(node):
+                return node
+        return None
+
+
+@dataclass
+class _Member:
+    """One replica's membership record."""
+
+    replica: object
+    name: str
+    index: int
+    healthy: bool = True
+    seen_beat: bool = False
+    last_hb_time: float = 0.0       # the replica's own stamp clock
+    last_beat: float = field(default_factory=time.monotonic)
+
+
+class _FleetEntry:
+    __slots__ = (
+        "rid", "request", "future", "submit_t", "deadline_s",
+        "member", "gen", "n_requeues", "affinity_key", "dispatch_t",
+    )
+
+    def __init__(self, rid: int, request: Request,
+                 deadline_s: float, affinity_key: bytes):
+        self.rid = rid
+        self.request = request
+        self.future = ServingFuture()
+        self.submit_t = time.monotonic()
+        self.deadline_s = deadline_s
+        self.member: _Member | None = None
+        self.gen = 0            # dispatch generation (stale-result guard)
+        self.n_requeues = 0
+        self.affinity_key = affinity_key
+        self.dispatch_t: float | None = None
+
+
+class Router:
+    """Thread-safe multi-replica front-end; see module docstring."""
+
+    def __init__(
+        self,
+        replicas=(),
+        *,
+        policy: str = "least_loaded",
+        fleet_queue_cap: int = 256,
+        default_deadline_s: float = 60.0,
+        replica_queue_cap: int | None = 32,
+        stall_timeout_s: float = 30.0,
+        startup_grace_s: float = 120.0,
+        health_interval_s: float = 0.02,
+        affinity_block: int = 16,
+        n_vnodes: int = 64,
+        max_requeues: int = 3,
+        recorder: FleetRecorder | None = None,
+    ):
+        if policy not in POLICIES:
+            raise ValueError(
+                f"policy must be one of {POLICIES}, got {policy!r}"
+            )
+        self.policy = policy
+        self.fleet_queue_cap = int(fleet_queue_cap)
+        self.default_deadline_s = float(default_deadline_s)
+        self.replica_queue_cap = (
+            None if replica_queue_cap is None else int(replica_queue_cap)
+        )
+        self.stall_timeout_s = float(stall_timeout_s)
+        self.startup_grace_s = float(startup_grace_s)
+        self.health_interval_s = float(health_interval_s)
+        self.affinity_block = int(affinity_block)
+        self.max_requeues = int(max_requeues)
+        self.recorder = recorder or FleetRecorder()
+
+        self._lock = threading.RLock()
+        self._members: list[_Member] = []
+        self._ring = ConsistentHashRing(n_vnodes)
+        self._pending: dict[int, _FleetEntry] = {}
+        self._queue: deque[int] = deque()    # rids awaiting dispatch
+        self._rid = itertools.count()
+        self._rr = 0
+        self._stopping = False
+        self._monitor: threading.Thread | None = None
+        self._stop = threading.Event()
+        for r in replicas:
+            self.add_replica(r)
+
+    # -- membership --------------------------------------------------------
+
+    def add_replica(self, replica, name: str | None = None) -> str:
+        """Register a replica (joins healthy; the watchdog takes it
+        from there).  Also the REJOIN path for a replica object the
+        caller relaunched under a new identity."""
+        with self._lock:
+            name = str(
+                name if name is not None
+                else getattr(replica, "name", f"replica{len(self._members)}")
+            )
+            if any(m.name == name for m in self._members):
+                raise ValueError(f"duplicate replica name {name!r}")
+            self._members.append(
+                _Member(replica=replica, name=name,
+                        index=len(self._members))
+            )
+            self._ring.add(name)
+            return name
+
+    def members(self) -> dict:
+        with self._lock:
+            return {
+                m.name: {"healthy": m.healthy,
+                         "alive": m.replica.alive()}
+                for m in self._members
+            }
+
+    def _healthy(self) -> list[_Member]:
+        return [m for m in self._members if m.healthy]
+
+    # -- admission (any thread) --------------------------------------------
+
+    def submit(self, prompt, **kw) -> ServingFuture:
+        """Queue one request on the fleet; the future ALWAYS resolves
+        (served by some replica, or shed with a reason)."""
+        if isinstance(prompt, Request):
+            if kw:
+                raise TypeError(
+                    f"submit(Request, ...) does not accept keyword "
+                    f"overrides {sorted(kw)} — set them on the "
+                    f"Request itself"
+                )
+            req = prompt
+        else:
+            req = Request(prompt=list(prompt), **kw)
+        deadline = (
+            req.deadline_s if req.deadline_s is not None
+            else self.default_deadline_s
+        )
+        entry = _FleetEntry(
+            next(self._rid), req, deadline,
+            # only the affinity policy reads the key — don't pay a
+            # sha1 over a 2k-token prompt on every least_loaded/
+            # round_robin submit
+            prefix_affinity_key(req.prompt, self.affinity_block)
+            if self.policy == "prefix_affinity" else b"",
+        )
+        with self._lock:
+            if self._stopping:
+                return self._shed(entry, "shutdown")
+            if len(self._pending) >= self.fleet_queue_cap:
+                return self._shed(entry, "queue_full")
+            self._pending[entry.rid] = entry
+            if self._queue:
+                # FIFO fairness: older router-held requests (back-
+                # pressured or failover-requeued) get first claim on
+                # any freed capacity — a fresh submit must not race
+                # past them to a slot and starve them to "deadline"
+                self._queue.append(entry.rid)
+                self._pump_locked()
+            elif not self._try_dispatch(entry):
+                self._queue.append(entry.rid)
+        return entry.future
+
+    def _shed(self, entry: _FleetEntry, reason: str) -> ServingFuture:
+        now = time.monotonic()
+        entry.future._set(Result(
+            status="shed", finish_reason=reason,
+            queued_s=now - entry.submit_t,
+        ))
+        self.recorder.record_request(
+            status="shed", finish_reason=reason,
+            n_prompt=len(entry.request.prompt), n_generated=0,
+            queued_s=now - entry.submit_t,
+        )
+        return entry.future
+
+    # -- dispatch (lock held) ----------------------------------------------
+
+    def _over_cap(self, m: _Member) -> bool:
+        return (
+            self.replica_queue_cap is not None
+            and m.replica.load() >= self.replica_queue_cap
+        )
+
+    def _choose(self, entry: _FleetEntry) -> _Member | None:
+        healthy = self._healthy()
+        if not healthy:
+            return None
+        if self.policy == "prefix_affinity":
+            by_name = {m.name: m for m in healthy}
+            name = self._ring.lookup(
+                entry.affinity_key,
+                skip=lambda n: (
+                    n not in by_name or self._over_cap(by_name[n])
+                ),
+            )
+            return by_name.get(name) if name is not None else None
+        if self.policy == "least_loaded":
+            # one load() probe per member: a consistent snapshot for
+            # both the cap filter and the pick (load() is a lock +
+            # possibly a wire-cache read on TCP replicas)
+            loads = [(m.replica.load(), m.index, m) for m in healthy]
+            free = [
+                t for t in loads
+                if self.replica_queue_cap is None
+                or t[0] < self.replica_queue_cap
+            ]
+            if not free:
+                return None
+            # deterministic tie-break: (load, member index)
+            return min(free, key=lambda t: t[:2])[2]
+        # round_robin: advance the cursor past unhealthy/saturated
+        for _ in range(len(healthy)):
+            m = healthy[self._rr % len(healthy)]
+            self._rr += 1
+            if not self._over_cap(m):
+                return m
+        return None
+
+    def _try_dispatch(self, entry: _FleetEntry) -> bool:
+        """Dispatch one pending entry if a member will take it; the
+        caller holds the lock.  Expired entries shed here (the
+        deadline generalizes across requeues: each redispatch carries
+        only the REMAINING budget)."""
+        now = time.monotonic()
+        remaining = entry.deadline_s - (now - entry.submit_t)
+        if remaining <= 0:
+            del self._pending[entry.rid]
+            self._shed(entry, "deadline")
+            return True      # terminal — no longer queued
+        member = self._choose(entry)
+        if member is None:
+            return False
+        entry.gen += 1
+        entry.member = member
+        entry.dispatch_t = now
+        gen = entry.gen
+        req = entry.request
+        efut = member.replica.submit(Request(
+            prompt=list(req.prompt), max_tokens=req.max_tokens,
+            temperature=req.temperature, deadline_s=remaining,
+            seed=req.seed,
+        ))
+        self.recorder.record_dispatch(member.name)
+        efut.add_done_callback(
+            lambda res, rid=entry.rid, gen=gen:
+                self._on_result(rid, gen, res)
+        )
+        return True
+
+    # -- completion (replica threads) --------------------------------------
+
+    def _on_result(self, rid: int, gen: int, res: Result) -> None:
+        with self._lock:
+            entry = self._pending.get(rid)
+            if entry is None or entry.gen != gen:
+                return    # stale: requeued elsewhere / double-resolve
+            if (
+                res.status == "shed"
+                and res.finish_reason in _REQUEUE_REASONS
+            ):
+                # the replica abandoned it without serving: failover
+                self._requeue_locked([entry])
+                return
+            del self._pending[rid]
+            if rid in self._queue:      # paranoia; dispatched rids
+                self._queue.remove(rid)  # are not queued
+        # re-base the latency fields on the ROUTER submit time — the
+        # replica measured from ITS OWN admission, which for a
+        # requeued or router-held request understates the wait
+        shift = (
+            entry.dispatch_t - entry.submit_t
+            if entry.dispatch_t is not None else 0.0
+        )
+        out = Result(
+            status=res.status, finish_reason=res.finish_reason,
+            tokens=list(res.tokens),
+            ttft_s=(
+                res.ttft_s + shift if res.ttft_s is not None else None
+            ),
+            tpot_s=res.tpot_s,
+            queued_s=(
+                res.queued_s + shift
+                if res.queued_s is not None else shift
+            ),
+            e2e_s=(
+                res.e2e_s + shift if res.e2e_s is not None else None
+            ),
+        )
+        entry.future._set(out)
+        self.recorder.record_request(
+            status=out.status, finish_reason=out.finish_reason,
+            n_prompt=len(entry.request.prompt),
+            n_generated=len(out.tokens),
+            ttft_s=out.ttft_s, tpot_s=out.tpot_s,
+            queued_s=out.queued_s, e2e_s=out.e2e_s,
+        )
+
+    # -- failover ----------------------------------------------------------
+
+    def _requeue_locked(self, entries: list) -> None:
+        n = 0
+        for entry in entries:
+            entry.gen += 1        # invalidate in-flight callbacks
+            entry.member = None
+            if entry.n_requeues >= self.max_requeues:
+                del self._pending[entry.rid]
+                self._shed(entry, "failover")
+                continue
+            entry.n_requeues += 1
+            self._queue.append(entry.rid)
+            n += 1
+        if n:
+            self.recorder.record_requeue(n)
+
+    def _fail_member(self, member: _Member, cause: str) -> None:
+        with self._lock:
+            if not member.healthy:
+                return
+            member.healthy = False
+            self.recorder.record_failover(member.name)
+            affected = [
+                e for e in self._pending.values()
+                if e.member is member
+            ]
+            self._requeue_locked(affected)
+
+    # -- health monitor ----------------------------------------------------
+
+    def start(self) -> "Router":
+        if self._monitor is not None:
+            raise RuntimeError("router already started")
+        self._stop.clear()
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="tm-fleet-monitor",
+            daemon=True,
+        )
+        self._monitor.start()
+        return self
+
+    def check_health(self) -> None:
+        """One watchdog pass (the monitor thread calls this every
+        ``health_interval_s``; tests may call it directly).
+        Liveness = a FRESH heartbeat stamp — supervisor semantics:
+        progress counters rewind on restart, fresh writes don't."""
+        now = time.monotonic()
+        with self._lock:
+            members = list(self._members)
+        for m in members:
+            hb = m.replica.heartbeat()
+            alive = m.replica.alive()
+            if hb.get("time", 0.0) > m.last_hb_time and alive:
+                m.last_hb_time = hb["time"]
+                m.last_beat = now
+                m.seen_beat = True
+                if not m.healthy:
+                    with self._lock:
+                        m.healthy = True
+                    self.recorder.record_rejoin(m.name)
+            if not m.healthy:
+                continue
+            limit = (
+                self.stall_timeout_s if m.seen_beat
+                else self.startup_grace_s
+            )
+            if not alive:
+                self._fail_member(m, "dead")
+            elif now - m.last_beat > limit:
+                self._fail_member(m, "stall")
+
+    def _pump_queue(self) -> None:
+        """Retry dispatch for router-held requests (backpressure
+        cleared, a member rejoined, or a deadline expired)."""
+        with self._lock:
+            self._pump_locked()
+
+    def _pump_locked(self) -> None:
+        rids = list(self._queue)
+        self._queue.clear()
+        for rid in rids:
+            entry = self._pending.get(rid)
+            if entry is None:
+                continue
+            if not self._try_dispatch(entry):
+                self._queue.append(rid)
+
+    def _monitor_loop(self) -> None:
+        while not self._stop.is_set():
+            self.check_health()
+            self._pump_queue()
+            time.sleep(self.health_interval_s)
+
+    # -- shutdown / observability ------------------------------------------
+
+    def drain(self, timeout: float = 300.0) -> bool:
+        """Block until every admitted request has resolved (True) or
+        the timeout passes (False) — the closed-loop bench idiom."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not self._pending:
+                    return True
+            if self._monitor is None:
+                # inline mode: no monitor thread pumping for us
+                self.check_health()
+                self._pump_queue()
+            time.sleep(self.health_interval_s)
+        with self._lock:
+            return not self._pending
+
+    def stop(self, drain_s: float = 30.0) -> None:
+        """Refuse new admissions, give in-flight work ``drain_s`` to
+        finish, then shed the stragglers ("shutdown") — every future
+        still resolves.  Replica lifecycles belong to the caller."""
+        with self._lock:
+            self._stopping = True
+        self.drain(timeout=drain_s)
+        with self._lock:
+            leftovers = list(self._pending.values())
+            self._pending.clear()
+            self._queue.clear()
+        for entry in leftovers:
+            entry.gen += 1   # silence any late replica callbacks
+            self._shed(entry, "shutdown")
+        self._stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=30.0)
+            self._monitor = None
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def refresh_replica_stats(self) -> None:
+        """Pull each reachable replica's recorder state (and paging
+        stats) into the fleet recorder — call before
+        ``fleet_summary`` (unreachable replicas keep their last
+        attached snapshot; their completions were recorded
+        router-side anyway)."""
+        with self._lock:
+            members = list(self._members)
+        for m in members:
+            try:
+                state = m.replica.recorder_state()
+                paging = m.replica.paging_stats()
+            except Exception:
+                continue   # dead/unreachable: keep the last snapshot
+            self.recorder.attach_replica(m.name, state, paging)
+
+    def fleet_summary(self) -> dict:
+        self.refresh_replica_stats()
+        out = self.recorder.summary()
+        out["members"] = self.members()
+        out["policy"] = self.policy
+        return out

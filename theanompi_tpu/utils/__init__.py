@@ -12,7 +12,11 @@ from theanompi_tpu.utils.checkpoint import (
     verify_checkpoint,
 )
 from theanompi_tpu.utils.compile_cache import enable_compile_cache
-from theanompi_tpu.utils.recorder import Recorder, ServingRecorder
+from theanompi_tpu.utils.recorder import (
+    FleetRecorder,
+    Recorder,
+    ServingRecorder,
+)
 from theanompi_tpu.utils.sharded_checkpoint import (
     is_sharded_checkpoint,
     load_sharded_checkpoint,
@@ -22,6 +26,7 @@ from theanompi_tpu.utils.sharded_checkpoint import (
 from theanompi_tpu.utils.supervisor import Supervisor, SupervisorGaveUp
 
 __all__ = [
+    "FleetRecorder",
     "Recorder",
     "ServingRecorder",
     "Supervisor",

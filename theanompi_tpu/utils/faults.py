@@ -19,6 +19,14 @@ with actions
 - ``corrupt_ckpt`` — flip bytes in the newest COMMITTED checkpoint
   (a post-commit bit-flip / truncated write), then die like a
   preemption: the relaunch must detect, quarantine, and fall back.
+- ``die_replica`` — raise :class:`ReplicaDied` out of the calling
+  loop.  The SERVING-fleet drill action (``serving/replica.py``):
+  the replica's owner loop dies mid-flight (``dead=True``, stale
+  heartbeat; a TCP replica's pongs start reporting ``alive=False``)
+  and the router's health check must fail over its queued and
+  in-flight requests.  For replica drills the ``<epoch>`` field is
+  the REPLICA INDEX and ``<iter>`` the replica's BUSY
+  engine-iteration count — same machinery, different clock.
 
 A fault fires at most ONCE.  Under a supervisor the relaunched
 process would otherwise re-read the same env and re-die at the same
@@ -43,7 +51,14 @@ from pathlib import Path
 _ENV = "TM_FAULT_AT"
 _STATE_ENV = "TM_FAULT_STATE"
 
-ACTIONS = ("die", "hang", "sigterm", "corrupt_ckpt")
+ACTIONS = ("die", "hang", "sigterm", "corrupt_ckpt", "die_replica")
+
+
+class ReplicaDied(RuntimeError):
+    """Raised by the ``die_replica`` fault action: ends the CALLING
+    loop (a serving replica's owner loop), not the whole process —
+    the replica reads as dead fleet-side (stale heartbeat /
+    ``alive=False``) while its host process stays inspectable."""
 
 #: parsed fault list — ``"unset"`` sentinel until first read, then
 #: ``None`` (no faults) or a list of ``(epoch, iter, action)``
@@ -89,8 +104,8 @@ def _target() -> list[tuple[int, int, str]] | None:
             except ValueError as err:
                 raise ValueError(
                     f"{_ENV} must be "
-                    f"'<epoch>:<iter>[:die|hang|sigterm|corrupt_ckpt]"
-                    f"[,...]', got {raw!r}"
+                    f"'<epoch>:<iter>[:die|hang|sigterm|corrupt_ckpt"
+                    f"|die_replica][,...]', got {raw!r}"
                 ) from err
             if not _parsed:
                 _parsed = None
@@ -182,6 +197,11 @@ def _execute(action: str, epoch: int, it: int,
         # stall watchdog ends this (SIGKILL; no handler could run)
         while True:
             time.sleep(3600)
+    if action == "die_replica":
+        raise ReplicaDied(
+            f"{_ENV}: die_replica fired at replica {epoch} "
+            f"iteration {it}"
+        )
     if action == "sigterm":
         # planned preemption: the worker's graceful handler (installed
         # by utils/supervisor.install_preemption_handler) sets the

@@ -388,6 +388,48 @@ class ServingRecorder:
                 else min(self.blocks_free_min, int(blocks_free))
             )
 
+    # -- aggregation (fleet serving, utils/recorder.FleetRecorder) ---------
+
+    def state_dict(self) -> dict:
+        """JSON-able raw state — what a TCP replica ships to the
+        router's ``FleetRecorder`` so fleet percentiles come from the
+        full sample, not from re-aggregated per-replica medians."""
+        return {
+            "max_slots": self.max_slots,
+            "requests": [dict(r) for r in self.requests],
+            "steps": [dict(s) for s in self.steps],
+            "blocks_in_use_max": self.blocks_in_use_max,
+            "blocks_free_min": self.blocks_free_min,
+        }
+
+    def load_state_dict(self, d: dict) -> None:
+        self.max_slots = int(d["max_slots"])
+        self.requests = [dict(r) for r in d["requests"]]
+        self.steps = [dict(s) for s in d["steps"]]
+        self.blocks_in_use_max = d.get("blocks_in_use_max")
+        self.blocks_free_min = d.get("blocks_free_min")
+
+    def merge(self, other) -> "ServingRecorder":
+        """Fold another recorder (or its ``state_dict()``) into this
+        one: requests and steps append, block gauges take the
+        extremes.  Merged steps are stamped with THEIR recorder's
+        ``max_slots`` so the combined ``slot_occupancy`` stays a
+        slot-seconds-weighted mean even when replicas differ in slot
+        count.  Returns ``self`` (chainable)."""
+        d = other.state_dict() if isinstance(other, ServingRecorder) \
+            else other
+        self.requests.extend(dict(r) for r in d["requests"])
+        slots = int(d["max_slots"])
+        for s in d["steps"]:
+            s = dict(s)
+            s.setdefault("max_slots", slots)
+            self.steps.append(s)
+        self.record_block_gauges(
+            blocks_in_use=d.get("blocks_in_use_max"),
+            blocks_free=d.get("blocks_free_min"),
+        )
+        return self
+
     def summary(self) -> dict:
         """One dict the bench row emits: throughput, latency
         percentiles, occupancy, queue pressure, shed accounting."""
@@ -397,10 +439,16 @@ class ServingRecorder:
         tpots = [r["tpot_s"] for r in ok if r["tpot_s"] is not None]
         decode_s = sum(s["dt_s"] for s in self.steps)
         tokens = sum(s["tokens"] for s in self.steps)
+        # merged steps carry their own max_slots (see merge()); the
+        # recorder's own steps use self.max_slots
+        cap_slot_s = sum(
+            s.get("max_slots", self.max_slots) * s["dt_s"]
+            for s in self.steps
+        )
         occ = (
             sum(s["active_slots"] * s["dt_s"] for s in self.steps)
-            / (self.max_slots * decode_s)
-            if decode_s else None
+            / cap_slot_s
+            if cap_slot_s else None
         )
         depths = [s["queue_depth"] for s in self.steps]
         shed_reasons = dict(Counter(r["finish_reason"] for r in shed))
@@ -437,3 +485,110 @@ class ServingRecorder:
             "blocks_in_use_max": self.blocks_in_use_max,
             "blocks_free_min": self.blocks_free_min,
         }
+
+
+class FleetRecorder:
+    """Telemetry sink for the multi-replica serving router
+    (``serving/router.py``).
+
+    Two independent data streams, merged honestly:
+
+    - **Router-side request stream** — every terminal result the
+      router delivers (completions AND router-level sheds), recorded
+      as it resolves.  Fleet TTFT/TPOT percentiles, shed breakdown
+      and token accounting come from HERE, so they stay complete
+      even when a replica dies and takes its own recorder with it
+      (the failed replica's earlier completions were already
+      recorded at the router).
+    - **Per-replica summaries** — each replica's ``ServingRecorder``
+      state (``attach_replica``), merged via
+      ``ServingRecorder.merge`` for step-level facts the router
+      cannot see: per-replica tokens/s, slot occupancy, prefix-cache
+      hit rate, replica-side shed reasons.  Replicas run
+      CONCURRENTLY, so the fleet aggregate rate is the SUM of
+      per-replica ``tokens_per_sec`` (their decode seconds overlap
+      in wall time — summing decode_s would understate throughput);
+      occupancy is the slot-seconds-weighted mean the merge
+      computes.
+
+    Router lifecycle counters (``record_requeue`` /
+    ``record_failover`` / ``record_rejoin`` / ``record_dispatch``)
+    land in the summary as the failover-accounting datum the bench's
+    kill-one-replica arm asserts on."""
+
+    def __init__(self):
+        self.router = ServingRecorder(max_slots=0)
+        self.replica_states: dict[str, dict] = {}
+        self.replica_paging: dict[str, dict | None] = {}
+        self.n_requeues = 0
+        self.n_failovers = 0
+        self.n_rejoins = 0
+        self.dispatched = Counter()
+
+    # -- router-side events ------------------------------------------------
+
+    def record_request(self, **kw) -> None:
+        self.router.record_request(**kw)
+
+    def record_dispatch(self, replica: str) -> None:
+        self.dispatched[str(replica)] += 1
+
+    def record_requeue(self, n: int = 1) -> None:
+        self.n_requeues += int(n)
+
+    def record_failover(self, replica: str) -> None:
+        self.n_failovers += 1
+
+    def record_rejoin(self, replica: str) -> None:
+        self.n_rejoins += 1
+
+    # -- replica summaries -------------------------------------------------
+
+    def attach_replica(self, name: str, state: dict,
+                       paging: dict | None = None) -> None:
+        """Adopt one replica's ``ServingRecorder.state_dict()`` (and
+        optional ``Engine.paging_stats()``) — latest attach per name
+        wins, so the router can refresh mid-run."""
+        self.replica_states[str(name)] = state
+        self.replica_paging[str(name)] = paging
+
+    def summary(self) -> dict:
+        out = {
+            k: v for k, v in self.router.summary().items()
+            if k in (
+                "n_requests", "n_completed", "n_shed", "shed_reasons",
+                "tokens_completed", "ttft_p50_s", "ttft_p95_s",
+                "tpot_p50_s", "tpot_p95_s", "finish_reasons",
+            )
+        }
+        out.update(
+            n_requeues=self.n_requeues,
+            n_failovers=self.n_failovers,
+            n_rejoins=self.n_rejoins,
+            dispatched=dict(self.dispatched),
+        )
+        per, merged = {}, ServingRecorder(max_slots=0)
+        for name, state in self.replica_states.items():
+            r = ServingRecorder()
+            r.load_state_dict(state)
+            s = r.summary()
+            per[name] = {
+                k: s[k] for k in (
+                    "tokens_per_sec", "slot_occupancy",
+                    "prefix_hit_rate", "shed_reasons", "n_completed",
+                    "tokens_generated", "decode_s",
+                )
+            }
+            merged.merge(state)
+        ms = merged.summary()
+        out["per_replica"] = per
+        out["slot_occupancy"] = ms["slot_occupancy"]
+        out["prefix_hit_rate"] = ms["prefix_hit_rate"]
+        out["tokens_generated"] = ms["tokens_generated"]
+        # concurrent replicas: aggregate rate is the sum of rates
+        rates = [
+            p["tokens_per_sec"] for p in per.values()
+            if p["tokens_per_sec"]
+        ]
+        out["aggregate_tokens_per_sec"] = sum(rates) if rates else None
+        return out

@@ -552,6 +552,63 @@ def serving_roofline(
     return out
 
 
+def fleet_roofline(
+    cfg: dict,
+    *,
+    offered_tokens_per_sec: float,
+    context: int,
+    tp: int = 1,
+    batch: int = 8,
+    chip: ChipSpec = V5E,
+    target_util: float = 0.8,
+    **roofline_kw,
+) -> dict:
+    """Replica-count planning for a target offered load (the fleet
+    router, ``serving/router.py``).
+
+    One replica's decode capacity comes from ``serving_roofline`` at
+    the replica's slot count (``batch``); a fleet of R replicas
+    serves ``R * capacity`` tokens/s.  The KNEE is the smallest R
+    whose utilization ``rho = offered / (R * capacity)`` drops below
+    ``target_util`` — past the knee, adding replicas buys headroom,
+    not latency.  Each row carries the M/M/1-style queue-wait
+    inflation ``1 / (1 - rho)`` (rho < 1): the TTFT p95 proxy that
+    explodes as a replica count SATURATES, which is what the bench's
+    offered-load sweep shows on the CPU mesh and an operator checks
+    against the real chip's datasheet capacity.
+
+    An infeasible fleet (rho >= 1) reports ``queue_inflation=None``:
+    the queue grows without bound and admission control (fleet queue
+    cap + deadlines) turns the excess into load-shed results.
+    """
+    assert 0.0 < target_util < 1.0, target_util
+    per = serving_roofline(
+        cfg, batch=batch, context=context, tp=tp, chip=chip,
+        **roofline_kw,
+    )
+    cap = per["tokens_per_sec"]
+    offered = float(offered_tokens_per_sec)
+    knee = int(max(1, -(-offered // (cap * target_util))))  # ceil
+    rows = {}
+    r = 1
+    while r <= 2 * knee:
+        rho = offered / (r * cap)
+        rows[r] = {
+            "utilization": rho,
+            "queue_inflation": 1.0 / (1.0 - rho) if rho < 1 else None,
+            "tokens_per_sec_capacity": r * cap,
+        }
+        r = r * 2 if r < knee // 2 else r + max(1, knee // 8)
+    return {
+        "per_replica_tokens_per_sec": cap,
+        "per_replica_slots": batch,
+        "offered_tokens_per_sec": offered,
+        "target_util": target_util,
+        "knee_replicas": knee,
+        "replicas": rows,
+    }
+
+
 def llama_step_flops(cfg: dict, batch: int, seq_len: int | None = None,
                      remat: bool = True) -> float:
     """Training FLOPs per step: 6*P*tokens for the matmuls (fwd 2PT +
