@@ -22,6 +22,10 @@
 #    TM_FAULT_AT=1:4:die_replica drill that kills it mid-generation;
 #    asserts every request completes with exact token accounting and
 #    at least one failover requeue was recorded (zero lost futures).
+# 5. elastic: shrink-resume — a supervised zero1+int8 run loses half
+#    its 8-device world mid-run and completes at 4 after a resharded
+#    resume; asserts resumed progress and the [8, 4] world-size
+#    history in the supervisor report (docs/RESILIENCE.md).
 #
 # Usage: bash scripts/bench_smoke.sh
 
@@ -98,3 +102,70 @@ if not arm["n_requeues"] >= 1:
     sys.exit("bench_smoke: fleet kill arm recorded no requeue: %s" % arm)
 print("bench_smoke: serving_fleet OK")
 '
+
+# 5. elastic shrink-resume: a supervised 8-device wresnet run under
+#    the full acceptance config (zero1 + bucketed + int8-EF) loses
+#    half its world mid-run (TM_FAULT_AT=1:1:shrink_world), resumes
+#    at 4 devices with the checkpoint resharded, and completes —
+#    asserts resumed progress (full loss curve, no step lost) and
+#    the world-size history [8, 4] in the supervisor report.
+python - <<'PYEOF'
+import json, os, sys, tempfile
+from pathlib import Path
+sys.path.insert(0, os.getcwd())
+from theanompi_tpu import launcher
+
+ckpt = Path(tempfile.mkdtemp()) / "ck"
+env = dict(os.environ)
+env.update(
+    JAX_PLATFORMS="cpu",
+    TM_TPU_PLATFORM="cpu",
+    PALLAS_AXON_POOL_IPS="",
+    XLA_FLAGS="--xla_force_host_platform_device_count=8",
+    PYTHONPATH=os.getcwd(),
+    TM_FAULT_AT="1:1:shrink_world",
+)
+n_epochs, nb = 3, 4
+h = launcher.launch(
+    "theanompi_tpu.workers.bsp_worker",
+    devices=list(range(8)),
+    modelfile="theanompi_tpu.models.wresnet",
+    modelclass="WResNet",
+    rule_kwargs=dict(
+        config={"batch_size": 4, "n_epochs": n_epochs, "depth": 10,
+                "widen": 1, "lr": 0.05, "lr_schedule": None,
+                "n_train": 128, "n_val": 32, "exch_strategy": "zero1",
+                "exchange_bucket_mb": 0.05, "exch_compression": "int8"},
+        checkpoint_dir=str(ckpt),
+        verbose=True,
+    ),
+    supervise=dict(max_restarts=3, stall_timeout_s=120.0,
+                   startup_grace_s=600.0, backoff_base_s=0.2,
+                   backoff_cap_s=1.0, poll_interval_s=0.25, seed=0,
+                   env=env),
+    elastic={"min_dp": 2},
+)
+report = h.wait()
+print("world history", report.get("world_size_history"),
+      "restarts", report["n_restarts"], "mttr", report["mttr_s"])
+if not report["completed"]:
+    sys.exit("bench_smoke: elastic run did not complete: %s" % report)
+if report.get("world_size_history") != [8, 4]:
+    sys.exit("bench_smoke: expected world history [8, 4], got %s"
+             % report.get("world_size_history"))
+ev = report["restarts"][0]
+if not (ev["cause"] == "preemption" and ev["world_size"] == 4
+        and ev["resharded"] is True):
+    sys.exit("bench_smoke: elastic restart event off: %s" % ev)
+from theanompi_tpu.utils import checkpoint_meta, latest_checkpoint
+meta = checkpoint_meta(latest_checkpoint(ckpt, validate=True))
+losses = meta["recorder"]["train_losses"]
+if meta.get("world_size") != 4 or meta["epoch"] != n_epochs - 1:
+    sys.exit("bench_smoke: final checkpoint not from the resized "
+             "world: %s" % {k: meta.get(k) for k in
+                            ("world_size", "epoch")})
+if len(losses) != n_epochs * nb:
+    sys.exit("bench_smoke: resumed progress off — %d losses, want %d"
+             % (len(losses), n_epochs * nb))
+print("bench_smoke: elastic shrink-resume OK")
+PYEOF

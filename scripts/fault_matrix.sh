@@ -2,7 +2,8 @@
 # Fault x recovery matrix — the deterministic self-healing grid
 # (docs/RESILIENCE.md): die / hang / sigterm / corrupt_ckpt faults
 # against npz / .shards checkpoints, driven through one supervised
-# launch() each, plus the fast resilience units.
+# launch() each, plus the fast resilience units and the elastic
+# world-resize arm (lose_device/shrink_world -> resharded resume).
 #
 # Runs ALONGSIDE scripts/tier1.sh, not inside it: the end-to-end
 # cells are marked `slow` (each is a multi-process training drill) so
@@ -28,9 +29,19 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu \
     -p no:cacheprovider -p no:xdist -p no:randomly "$@" \
     2>&1 | tee /tmp/_fm.log || exit $?
 
+# elastic arm: the resharding layer's fast units + bitwise round
+# trip (permutation primitives, shrink/grow load, refusal surface) —
+# cheap, and the layer every elastic drill below depends on
+timeout -k 10 870 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_reshard.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly "$@" \
+    2>&1 | tee -a /tmp/_fm.log || exit $?
+
 # the grid: every fault_matrix-tagged end-to-end drill (supervised
 # die+hang+corrupt in one launch, sigterm zero-step preemption,
-# sharded-format corruption fallback, budget exhaustion)
+# sharded-format corruption fallback, budget exhaustion, and the
+# elastic world-resize drill — shrink_world 8→4, loss curve vs an
+# uninterrupted equal-batch run, grow back to 8)
 timeout -k 10 1800 env JAX_PLATFORMS=cpu TM_SLOW_TESTS=1 \
     python -m pytest tests/ -q -m fault_matrix \
     -p no:cacheprovider -p no:xdist -p no:randomly "$@" \

@@ -361,3 +361,37 @@ def test_bsp_efficiency_compression_kwarg():
     int8 = bsp_efficiency(**base, compression="int8")
     assert int8["wire_mb"] < fp32["wire_mb"] / 3.5
     assert int8["efficiency_overlap"] >= fp32["efficiency_overlap"]
+
+
+def test_elastic_resume_cost():
+    """The elastic-resume predictor (ISSUE 8): resharding pays a
+    one-time gather+rescatter through host bandwidth, then trains at
+    n_new/n_old throughput — it beats waiting for replacement
+    hardware for any outage longer than the reshard itself."""
+    from theanompi_tpu.utils.scaling_model import elastic_resume_cost
+
+    base = dict(
+        param_bytes=4 * 25e6, step_time_s=0.1, n_old=8, n_new=4,
+    )
+    adam = elastic_resume_cost(**base, optimizer="adam")
+    mom = elastic_resume_cost(**base, optimizer="momentum")
+    # adam carries m+v (2x), momentum velocity alone (1x)
+    assert adam["state_bytes"] == pytest.approx(2 * mom["state_bytes"])
+    # every byte crosses host memory twice (gather + re-scatter)
+    assert adam["moved_bytes"] == pytest.approx(2 * adam["state_bytes"])
+    assert adam["reshard_s"] > 0
+    assert adam["reshard_steps_equiv"] == pytest.approx(
+        adam["reshard_s"] / 0.1
+    )
+    assert adam["throughput_frac"] == pytest.approx(0.5)
+    # elastic wins for any outage longer than the reshard pause
+    assert adam["break_even_outage_s"] == pytest.approx(
+        adam["reshard_s"]
+    )
+    # error feedback adds the n_old per-device r1 residuals — the
+    # dominant term at wide worlds
+    ef = elastic_resume_cost(**base, error_feedback=True)
+    assert ef["state_bytes"] > adam["state_bytes"] + 7 * base["param_bytes"]
+    # sgd has no optimizer state but EF still moves bytes
+    sgd = elastic_resume_cost(**base, optimizer="sgd")
+    assert sgd["state_bytes"] == 0 and sgd["reshard_s"] == 0

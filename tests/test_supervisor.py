@@ -547,3 +547,196 @@ os._exit(137)
             s.run()
         # gave up after the crash-loop budget, far under max_restarts
         assert ei.value.report["n_restarts"] <= 3
+
+
+# ---------------------------------------------------------------------------
+# elastic supervision (ISSUE 8): resize the world, don't just relaunch
+# ---------------------------------------------------------------------------
+
+class TestElasticSupervisor:
+    def test_relaunch_resizes_world(self, tmp_path):
+        """A lose-device drill writes the world file and dies; the
+        relaunch must be spawned at the SMALLER device count (passed
+        through cmd_for), the event must record it, and the report's
+        world_size_history must read [8, 4]."""
+        child = _write_child(tmp_path, """
+import json, os, sys, time
+hb = os.environ["TM_HEARTBEAT_FILE"]
+n = int(sys.argv[1])
+open(hb, "w").write(json.dumps(
+    {"progress": 1, "status": "running", "time": time.time(),
+     "world_size": n}))
+time.sleep(0.2)
+if n == 8:  # first life: shrink the world, die like a preemption
+    open(os.environ["TM_WORLD_FILE"], "w").write("4")
+    os._exit(137)
+ctx = json.loads(os.environ["TM_RESTART_CONTEXT"])
+assert ctx["world_size"] == 4, ctx
+open(hb, "w").write(json.dumps(
+    {"progress": 2, "status": "completed", "time": time.time(),
+     "world_size": n, "resharded": True}))
+""")
+        s = sup.Supervisor(
+            cmd_for=lambda r, n_devices=None: [
+                sys.executable, str(child), str(n_devices)
+            ],
+            checkpoint_dir=str(tmp_path / "ck"),
+            elastic=True, n_devices=8, elastic_min_dp=2,
+            backoff_base_s=0.01, backoff_cap_s=0.05,
+            poll_interval_s=0.05, verbose=False, seed=0,
+        )
+        report = s.run()
+        assert report["completed"]
+        assert report["elastic"] is True
+        assert report["world_size_history"] == [8, 4]
+        (ev,) = report["restarts"]
+        assert ev["world_size"] == 4
+        assert ev["resharded"] is True
+
+    def test_min_dp_gives_up_loudly(self, tmp_path):
+        ck = tmp_path / "ck"
+        ck.mkdir()
+        (ck / ".world").write_text("1")
+        s = sup.Supervisor(
+            cmd_for=lambda r, n_devices=None: [sys.executable, "-c", ""],
+            checkpoint_dir=str(ck),
+            elastic=True, n_devices=8, elastic_min_dp=2,
+            poll_interval_s=0.05, verbose=False, seed=0,
+        )
+        with pytest.raises(sup.SupervisorGaveUp, match="elastic_min_dp"):
+            s.run()
+
+    def test_elastic_requires_baseline(self, tmp_path):
+        with pytest.raises(ValueError, match="n_devices"):
+            sup.Supervisor(
+                cmd_for=lambda r: [],
+                checkpoint_dir=str(tmp_path / "ck"),
+                elastic=True,
+            )
+
+    def test_probe_clamps_and_ignores_garbage(self, tmp_path):
+        ck = tmp_path / "ck"
+        s = sup.Supervisor(
+            cmd_for=lambda r: [],
+            checkpoint_dir=str(ck),
+            elastic=True, n_devices=8, verbose=False,
+        )
+        assert s._probe_world() == 8          # no file: baseline
+        (ck / ".world").write_text("16")
+        assert s._probe_world() == 8          # never grows past it
+        (ck / ".world").write_text("6")
+        assert s._probe_world() == 6
+        (ck / ".world").write_text("nonsense")
+        assert s._probe_world() == 8          # garbage ignored
+
+    def test_cmd_factory_resizes_device_list(self):
+        cmd_for = sup.make_worker_cmd_factory(
+            "theanompi_tpu.workers.bsp_worker",
+            devices=list(range(8)),
+            modelfile="m", modelclass="C", rule_kwargs={},
+        )
+        spec = json.loads(cmd_for(True)[-1])
+        assert spec["devices"] == list(range(8))
+        spec = json.loads(cmd_for(True, n_devices=4)[-1])
+        assert spec["devices"] == [0, 1, 2, 3]
+        assert spec["kwargs"]["resume"] is True
+
+
+class TestElasticFaults:
+    def test_parse_accepts_world_actions(self, clean_faults,
+                                         monkeypatch):
+        monkeypatch.setenv(
+            "TM_FAULT_AT", "0:1:lose_device,1:2:shrink_world"
+        )
+        assert faults._target() == [
+            (0, 1, "lose_device"), (1, 2, "shrink_world"),
+        ]
+
+    def test_lose_device_needs_world_file(self, clean_faults,
+                                          monkeypatch):
+        monkeypatch.setenv("TM_FAULT_AT", "0:0:lose_device")
+        monkeypatch.delenv("TM_WORLD_FILE", raising=False)
+        with pytest.raises(RuntimeError, match="TM_WORLD_FILE"):
+            faults.maybe_inject_fault(0, 0, world=8)
+
+    @pytest.mark.parametrize("action,start,want", [
+        ("lose_device", 8, 7), ("shrink_world", 8, 4),
+        ("shrink_world", 1, 1),
+    ])
+    def test_world_actions_write_file_and_die(self, tmp_path, action,
+                                              start, want):
+        """The drill writes the shrunken count BEFORE dying 137 (a
+        subprocess: os._exit can't be caught in-process)."""
+        import subprocess
+
+        wf = tmp_path / "world"
+        code = (
+            "from theanompi_tpu.utils import faults\n"
+            f"faults.maybe_inject_fault(0, 0, world={start})\n"
+        )
+        env = dict(os.environ)
+        env.update(
+            TM_FAULT_AT=f"0:0:{action}",
+            TM_WORLD_FILE=str(wf),
+            PYTHONPATH=str(Path(__file__).resolve().parent.parent),
+        )
+        r = subprocess.run(
+            [sys.executable, "-c", code], env=env,
+            capture_output=True, text=True, timeout=120,
+        )
+        assert r.returncode == 137, (r.returncode, r.stderr)
+        assert int(wf.read_text().strip()) == want
+
+    def test_compounding_uses_file_over_baseline(self, tmp_path,
+                                                 clean_faults,
+                                                 monkeypatch):
+        """A second drill in a relaunched process compounds from the
+        FILE's count, not the caller's baseline."""
+        wf = tmp_path / "world"
+        wf.write_text("5")
+        monkeypatch.setenv("TM_WORLD_FILE", str(wf))
+        with pytest.raises(SystemExit):
+            # patch os._exit so the in-process unit survives the die
+            real_exit = os._exit
+            try:
+                os._exit = lambda code: (_ for _ in ()).throw(
+                    SystemExit(code)
+                )
+                faults._shrink_world("lose_device", 8)
+            finally:
+                os._exit = real_exit
+        assert int(wf.read_text().strip()) == 4
+
+
+class TestElasticWorldFit:
+    def test_global_policy_trims_to_dividing_width(self, tmp_path):
+        """lose_device leaves 7 of 8 devices; a 32 global batch can't
+        shard 7 ways — the worker must continue at dp=4 (idling 3)
+        instead of crash-looping on the divisibility refusal."""
+        from theanompi_tpu.workers.bsp_worker import (
+            _elastic_trim_devices,
+        )
+
+        save_checkpoint(
+            tmp_path, 0, {},
+            meta={"world_size": 8, "global_batch": 32},
+        )
+        cfg = {"batch_size": 4}
+        out = _elastic_trim_devices(
+            list(range(7)), cfg, str(tmp_path), verbose=False
+        )
+        assert out == [0, 1, 2, 3]
+        # a dividing width passes through untouched
+        assert _elastic_trim_devices(
+            list(range(4)), cfg, str(tmp_path), verbose=False
+        ) == [0, 1, 2, 3]
+        # per_replica policy keeps every surviving device
+        assert _elastic_trim_devices(
+            list(range(7)),
+            {**cfg, "elastic_batch_policy": "per_replica"},
+            str(tmp_path), verbose=False,
+        ) == list(range(7))
+        # no checkpoint yet: nothing to fit against
+        assert _elastic_trim_devices(
+            list(range(7)), cfg, str(tmp_path / "empty"), verbose=False
+        ) == list(range(7))

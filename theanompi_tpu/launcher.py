@@ -83,6 +83,7 @@ def launch(
     mode: str = "subprocess",
     rule_kwargs: dict | None = None,
     supervise: dict | None = None,
+    elastic: dict | bool | None = None,
 ) -> LaunchHandle:
     """``mode="supervised"`` (or any ``supervise={...}`` kwargs) wraps
     the worker subprocess in ``utils.supervisor.Supervisor``: worker
@@ -94,12 +95,43 @@ def launch(
     out raises ``SupervisorGaveUp`` (loud, never a silent loop).
     ``supervise`` keys = ``Supervisor`` kwargs (``max_restarts``,
     ``stall_timeout_s``, ``backoff_base_s``, ``crash_loop_budget``,
-    ...)."""
+    ...).
+
+    ``elastic`` (implies supervised) makes the run survive PERMANENT
+    capacity loss by resizing the world instead of waiting: each
+    relaunch probes the available device count and runs at that
+    width, the worker reshards its checkpoint onto the new layout
+    (``config["elastic"]`` is set for it), and the report carries the
+    per-launch ``world_size_history``.  Pass ``True`` or a dict:
+    ``{"min_dp": 2}`` bounds how far the world may shrink
+    (``tmlauncher --elastic-min-dp``); see docs/RESILIENCE.md."""
     rule_kwargs = dict(rule_kwargs or {})
     if supervise is None:
         # rule.init(..., launch="supervised", supervise={...}) arrives
         # through rule_kwargs — pull it out before it reaches run()
         supervise = rule_kwargs.pop("supervise", None)
+    if elastic is None:
+        elastic = rule_kwargs.pop("elastic", None)
+    if elastic:
+        el = dict(elastic) if isinstance(elastic, dict) else {}
+        n_dev = (
+            len(devices) if devices is not None else el.get("n_devices")
+        )
+        if not n_dev:
+            raise ValueError(
+                "elastic launch needs an explicit baseline world: "
+                "pass devices=[...] or elastic={'n_devices': N}"
+            )
+        supervise = dict(supervise or {})
+        supervise.setdefault("elastic", True)
+        supervise.setdefault("elastic_min_dp", int(el.get("min_dp", 1)))
+        supervise.setdefault("n_devices", int(n_dev))
+        # the worker side of elasticity: reshard on load + batch/LR
+        # policy (workers/bsp_worker._apply_elastic_policy)
+        cfg = dict(rule_kwargs.get("config") or {})
+        cfg.setdefault("elastic", True)
+        rule_kwargs["config"] = cfg
+        mode = "supervised"
     if mode == "supervised" or supervise is not None:
         from theanompi_tpu.utils.supervisor import (
             Supervisor,
@@ -243,7 +275,22 @@ def main(argv: Sequence[str] | None = None) -> int:
                     help="supervisor hang watchdog: kill + relaunch "
                     "after this many seconds without a heartbeat "
                     "(with --supervise)")
+    ap.add_argument("--elastic-min-dp", type=int, default=None,
+                    help="elastic self-healing (implies --supervise): "
+                    "relaunch at the surviving device count after a "
+                    "permanent capacity loss, resharding the "
+                    "checkpoint onto the new layout, down to this "
+                    "minimum dp; needs --devices (the baseline world) "
+                    "and checkpoint_dir in --kwargs")
     ns = ap.parse_args(argv)
+
+    if ns.elastic_min_dp is not None:
+        if ns.devices is None:
+            ap.error(
+                "--elastic-min-dp needs --devices N (the baseline "
+                "world size the run starts at)"
+            )
+        ns.supervise = True
 
     if ns.supervise and ns.coordinator is not None:
         # the supervised child is spawned WITHOUT the coordinator
@@ -271,6 +318,8 @@ def main(argv: Sequence[str] | None = None) -> int:
             "max_restarts": ns.max_restarts,
             "stall_timeout_s": ns.stall_timeout_s,
         }
+    if ns.elastic_min_dp is not None:
+        extra["elastic"] = {"min_dp": ns.elastic_min_dp}
     rule.init(
         devices=devices,
         modelfile=ns.modelfile,

@@ -175,6 +175,20 @@ class TMModel:
         skipping) the epoch.  ``config['keep_last_checkpoints']``
         bounds on-disk history for supervised many-restart runs."""
         meta = {"epoch": self.epoch, "lr": self.current_lr}
+        # world stamp (elastic resume): the DP replica count and the
+        # global batch this run trained at — the resharding loader
+        # needs the shard count the flat layouts were written under,
+        # and the worker's elastic_batch_policy needs the global batch
+        # to hold it constant across a world change
+        mesh = getattr(self, "mesh", None)
+        if mesh is not None:
+            from theanompi_tpu.parallel import dp_replicas
+
+            meta["world_size"] = int(dp_replicas(mesh))
+            meta["n_devices"] = int(mesh.devices.size)
+        gb = getattr(getattr(self, "data", None), "global_batch", None)
+        if gb is not None:
+            meta["global_batch"] = int(gb)
         if recorder is not None:
             meta["recorder"] = recorder.state_dict()
         if extra_meta:
@@ -209,23 +223,38 @@ class TMModel:
                 directory, self.epoch, trees, meta, keep_last=keep_last
             )
 
-    def load(self, directory: str, recorder: Recorder | None = None) -> bool:
-        # validate by default: a post-commit bit flip must fall back
-        # to the previous valid checkpoint (quarantining the corrupt
-        # one), never load blindly.  config['validate_checkpoint']=False
-        # opts out (e.g. enormous sharded trees on a trusted store).
-        validate = bool(
-            getattr(self, "config", {}).get("validate_checkpoint", True)
+    def _world_hint(self, path) -> tuple[dict, int | None, bool]:
+        """``(meta, n_here, world_changed)`` of a checkpoint, read
+        WITHOUT loading arrays.  ``world_changed`` is the one rule
+        both the reshard plan and the refusal guards share: a
+        (padded, bucket_len) stamp can COINCIDE across worlds (both
+        round to multiples of n), but the bucket-major storage
+        permutation is n-dependent and r1 residuals are per-device
+        state — so the world stamp, not the layout stamp alone,
+        decides."""
+        from theanompi_tpu.utils.checkpoint import checkpoint_meta
+
+        meta = checkpoint_meta(path)
+        n_here = None
+        if self.mesh is not None:
+            from theanompi_tpu.parallel import dp_replicas
+
+            n_here = int(dp_replicas(self.mesh))
+        world_changed = (
+            meta.get("world_size") is not None
+            and n_here is not None
+            and int(meta["world_size"]) != n_here
         )
-        path = latest_checkpoint(directory, validate=validate)
-        if path is None:
-            return False
-        like = self.checkpoint_trees()
+        return meta, n_here, world_changed
+
+    def _load_trees(self, path, like: dict) -> tuple[dict, dict]:
+        """Format dispatch + the curated missing-EF diagnostic (both
+        load paths — a raw KeyError for the residual group is a dead
+        end either way)."""
         try:
             if is_sharded_checkpoint(path):
-                trees, meta = load_sharded_checkpoint(path, like)
-            else:
-                trees, meta = load_checkpoint(path, like)
+                return load_sharded_checkpoint(path, like)
+            return load_checkpoint(path, like)
         except KeyError as e:
             # only translate when the MISSING leaf is the residual's
             # (both loaders name the group in the error) — any other
@@ -242,16 +271,148 @@ class TMModel:
                     f"exch_compression='none'"
                 ) from e
             raise
-        # bucket-layout guard BEFORE any state is attached: when this
+
+    def _reshard_plan(self, meta: dict, n_new: int | None,
+                      world_changed: bool, like: dict) -> dict | None:
+        """Decide whether an elastic load must reshard the flat
+        exchange layouts (zero1 optimizer shards, EF residuals).
+        ``None`` = layouts already match (or no layout-sensitive
+        state) — the normal loader runs."""
+        cur_z = getattr(self, "_zero1_layout", None)
+        cur_ef = getattr(self, "_ef_layout", None)
+        saved_z = meta.get("zero1_layout")
+        saved_ef = meta.get("ef_layout")
+        groups: dict[str, tuple] = {}
+        if cur_z is not None and saved_z is not None and (
+            tuple(saved_z) != tuple(cur_z)
+            or (cur_z[1] and world_changed)
+        ):
+            groups["opt_state"] = (tuple(saved_z), tuple(cur_z))
+        if cur_ef is not None and saved_ef is not None and "ef_state" in like:
+            if saved_ef[0] != cur_ef[0]:
+                raise ValueError(
+                    f"elastic resume cannot reshard across wire "
+                    f"formats: the checkpoint's EF residual was "
+                    f"written under exch_compression="
+                    f"{saved_ef[0]!r}, the compiled exchange uses "
+                    f"{cur_ef[0]!r} — the layouts/padding may change "
+                    f"across worlds, the compression must not"
+                )
+            # r1 is PER-DEVICE state: any world change reshards the
+            # residual group, equal layout stamps or not
+            if tuple(saved_ef) != tuple(cur_ef) or world_changed:
+                groups["ef_state"] = (
+                    (saved_ef[1], saved_ef[2]),
+                    (self._ef_layout[1], self._ef_layout[2]),
+                )
+        if not groups:
+            return None
+        return {
+            "groups": groups,
+            "world_size": meta.get("world_size"),
+            "n_new": n_new,
+            "size": sum(
+                math.prod(jnp.shape(l))
+                for l in jax.tree.leaves(self.params)
+            ),
+        }
+
+    def _load_resharded(
+        self, path, like: dict, plan: dict
+    ) -> tuple[dict, dict]:
+        """The elastic load: layout-portable groups (params,
+        net_state) restore through the normal cross-layout loaders;
+        layout-SENSITIVE flat buffers are read raw at their saved
+        shapes, gathered to master (pack) order, and re-scattered
+        under the compiled layout (``utils/reshard.py``) — an exact
+        permutation, so gathered optimizer state stays bitwise."""
+        from theanompi_tpu.utils import reshard as _reshard
+        from theanompi_tpu.utils.checkpoint import load_npz_group
+        from theanompi_tpu.utils.sharded_checkpoint import (
+            load_sharded_group,
+        )
+
+        groups = plan["groups"]
+        direct = {g: t for g, t in like.items() if g not in groups}
+        trees, meta = self._load_trees(path, direct)
+        raw_load = (
+            load_sharded_group if is_sharded_checkpoint(path)
+            else load_npz_group
+        )
+        n_old, n_new = plan["world_size"], plan["n_new"]
+        for group, (old, new) in groups.items():
+            fn = (
+                _reshard.reshard_ef_tree if group == "ef_state"
+                else _reshard.reshard_flat_tree
+            )
+            trees[group] = fn(
+                raw_load(path, group),
+                like[group],
+                size=plan["size"],
+                old=(n_old, *old),
+                new=(n_new, *new),
+            )
+        print(
+            f"elastic resume: resharded {sorted(groups)} from world "
+            f"{n_old} to world {n_new} "
+            f"(gather to master order, re-scatter)",
+            flush=True,
+        )
+        return trees, meta
+
+    def load(
+        self,
+        directory: str,
+        recorder: Recorder | None = None,
+        reshard: bool | None = None,
+    ) -> bool:
+        """Restore the newest valid checkpoint.  ``reshard=True`` (or
+        ``config["elastic"]`` truthy) enables the ELASTIC path: a
+        checkpoint whose zero1/EF flat layouts were written under a
+        different data-parallel width is gathered to master order and
+        re-scattered onto the compiled layout instead of refusing —
+        the resize-the-world resume (docs/RESILIENCE.md)."""
+        if reshard is None:
+            reshard = bool(getattr(self, "config", {}).get("elastic"))
+        # validate by default: a post-commit bit flip must fall back
+        # to the previous valid checkpoint (quarantining the corrupt
+        # one), never load blindly.  config['validate_checkpoint']=False
+        # opts out (e.g. enormous sharded trees on a trusted store).
+        validate = bool(
+            getattr(self, "config", {}).get("validate_checkpoint", True)
+        )
+        path = latest_checkpoint(directory, validate=validate)
+        if path is None:
+            return False
+        like = self.checkpoint_trees()
+        meta_hint, n_here, world_changed = self._world_hint(path)
+        plan = (
+            self._reshard_plan(meta_hint, n_here, world_changed, like)
+            if reshard else None
+        )
+        if plan is not None:
+            trees, meta = self._load_resharded(path, like, plan)
+            return self._finish_load(
+                trees, meta, recorder,
+                resharded={
+                    "world_size": plan["world_size"],
+                    "groups": sorted(plan["groups"]),
+                },
+            )
+        # bucket-layout guard BEFORE anything loads (the raw shape
+        # mismatch a cross-world zero1 resume would otherwise die on
+        # is a dead end; this one names the escape hatch): when this
         # model already compiled a zero1 step, the restored flat
         # optimizer shard is only meaningful under the layout it was
         # saved with (missing marker = a pre-bucketing monolithic
-        # checkpoint)
+        # checkpoint), and — _world_hint's coinciding-stamp rule — a
+        # bucketed layout under a DIFFERENT world is a mismatch even
+        # when the stamps agree
         cur = getattr(self, "_zero1_layout", None)
-        if cur is not None and "opt_state" in trees:
-            saved = meta.get("zero1_layout")
+        if cur is not None and "opt_state" in like:
+            saved = meta_hint.get("zero1_layout")
             saved = tuple(saved) if saved is not None else (cur[0], 0)
-            if saved != tuple(cur):
+            if saved != tuple(cur) or (cur[1] and world_changed):
                 raise ValueError(
                     f"zero1 optimizer checkpoint layout {saved} "
                     f"(padded, bucket_len) does not match the "
@@ -260,25 +421,63 @@ class TMModel:
                     f"resuming would silently pair adam/momentum "
                     f"rows with the wrong parameters; set "
                     f"exchange_bucket_mb to the value the checkpoint "
-                    f"was trained with"
+                    f"was trained with, or pass reshard=True to "
+                    f"load() / set config['elastic']=True to gather "
+                    f"the shards to master order and re-scatter them "
+                    f"onto this layout (elastic resume, "
+                    f"docs/RESILIENCE.md)"
                 )
         # EF-layout guard, same shape as the zero1 one: the residual's
         # flat order is (compression, padded, bucket_len)-dependent,
         # so a mismatched resume must refuse instead of re-injecting
         # rows against the wrong parameters
         cur_ef = getattr(self, "_ef_layout", None)
-        if cur_ef is not None and "ef_state" in trees:
-            saved_ef = meta.get("ef_layout")
-            if saved_ef is None or tuple(saved_ef) != tuple(cur_ef):
+        if cur_ef is not None and "ef_state" in like:
+            saved_ef = meta_hint.get("ef_layout")
+            # a checkpoint with NO residual at all (saved_ef None)
+            # falls through to the loader's missing-group diagnostic
+            if saved_ef is not None and (
+                tuple(saved_ef) != tuple(cur_ef) or world_changed
+            ):
                 raise ValueError(
                     f"checkpoint EF-residual layout "
-                    f"{saved_ef and tuple(saved_ef)} (compression, "
+                    f"{tuple(saved_ef)} (compression, "
                     f"padded, bucket_len) does not match the compiled "
                     f"exchange layout {tuple(cur_ef)} — set "
                     f"exch_compression/exchange_bucket_mb to the "
-                    f"values the checkpoint was trained with"
+                    f"values the checkpoint was trained with, or "
+                    f"pass reshard=True to load() / set "
+                    f"config['elastic']=True to carry the residual "
+                    f"across the layout change (elastic resume, "
+                    f"docs/RESILIENCE.md; the compression itself "
+                    f"must still match)"
                 )
-        self._restored_ef_layout = meta.get("ef_layout")
+        trees, meta = self._load_trees(path, like)
+        return self._finish_load(trees, meta, recorder)
+
+    def _finish_load(
+        self,
+        trees: dict,
+        meta: dict,
+        recorder: Recorder | None,
+        resharded: dict | None = None,
+    ) -> bool:
+        """Attach restored trees + metadata (shared by the normal and
+        elastic-reshard load paths).  After a reshard the state lives
+        in the COMPILED layout, so the restored-layout markers record
+        the current stamps, not the checkpoint's."""
+        if resharded is None:
+            self._restored_ef_layout = meta.get("ef_layout")
+            self._restored_zero1_layout = meta.get("zero1_layout")
+        else:
+            cur_ef = getattr(self, "_ef_layout", None)
+            cur_z = getattr(self, "_zero1_layout", None)
+            self._restored_ef_layout = (
+                list(cur_ef) if cur_ef is not None else None
+            )
+            self._restored_zero1_layout = (
+                list(cur_z) if cur_z is not None else None
+            )
         self._restored_ef = "ef_state" in trees
         # the checkpoint carries an EF residual (its layout is
         # stamped) that this load did NOT attach — the model hasn't
@@ -288,14 +487,15 @@ class TMModel:
         # of silently installing fresh zero residuals (compile-then-
         # load is the supported order, as for zero1 state).
         self._restored_ef_orphaned = (
-            meta.get("ef_layout") is not None
+            resharded is None
+            and meta.get("ef_layout") is not None
             and "ef_state" not in trees
         )
-        self._restored_zero1_layout = meta.get("zero1_layout")
         # workers read this for resilience metadata the load() bool
         # can't carry: next_iter (mid-epoch preemption checkpoints),
-        # preempted flag, restored recorder history
+        # preempted flag, restored recorder history, the saved world
         self.restored_meta = meta
+        self.resharded_from = resharded
         for group, tree in trees.items():
             setattr(self, group, tree)
         # compile_iter_fns consults this: compiling with a zero1

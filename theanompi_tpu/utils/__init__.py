@@ -4,8 +4,10 @@ TPU-native rebuild of ``theanompi/lib/{recorder,helper_funcs}.py``.
 """
 
 from theanompi_tpu.utils.checkpoint import (
+    checkpoint_meta,
     latest_checkpoint,
     load_checkpoint,
+    load_npz_group,
     prune_checkpoints,
     quarantine_checkpoint,
     save_checkpoint,
@@ -20,6 +22,7 @@ from theanompi_tpu.utils.recorder import (
 from theanompi_tpu.utils.sharded_checkpoint import (
     is_sharded_checkpoint,
     load_sharded_checkpoint,
+    load_sharded_group,
     save_sharded_checkpoint,
     verify_sharded_checkpoint,
 )
@@ -42,4 +45,7 @@ __all__ = [
     "load_sharded_checkpoint",
     "is_sharded_checkpoint",
     "verify_sharded_checkpoint",
+    "checkpoint_meta",
+    "load_npz_group",
+    "load_sharded_group",
 ]

@@ -145,6 +145,41 @@ def load_checkpoint(
     return out, meta
 
 
+def checkpoint_meta(path: str | Path) -> dict:
+    """Metadata of a committed checkpoint WITHOUT loading any arrays
+    (either format) — the cheap peek the elastic resume path uses to
+    learn the saved world size / flat layouts before deciding whether
+    to reshard.  Missing sidecar → ``{}``."""
+    path = Path(path)
+    mp = (
+        path / "meta.json" if path.name.endswith(".shards")
+        else path.with_suffix(".json")
+    )
+    if not mp.exists():
+        return {}
+    meta = json.loads(mp.read_text())
+    for k in _INTERNAL_META:
+        meta.pop(k, None)
+    return meta
+
+
+def load_npz_group(path: str | Path, group: str) -> dict[str, np.ndarray]:
+    """One group's raw arrays keyed by leaf path, at their SAVED
+    shapes — no ``like`` tree, no shape validation.  The elastic
+    loader reads layout-sensitive groups (zero1 opt state, EF
+    residuals) this way and reshards them on host
+    (``utils/reshard.py``)."""
+    prefix = f"{group}:"
+    with np.load(Path(path)) as z:
+        out = {
+            k[len(prefix):]: z[k] for k in z.files
+            if k.startswith(prefix)
+        }
+    if not out:
+        raise KeyError(f"checkpoint {path} has no group {group!r}")
+    return out
+
+
 def verify_checkpoint(path: str | Path) -> bool:
     """Deep-probe one committed checkpoint: structurally readable AND
     every array matches its save-time digest.  Checkpoints from before

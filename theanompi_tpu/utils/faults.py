@@ -27,6 +27,14 @@ with actions
   in-flight requests.  For replica drills the ``<epoch>`` field is
   the REPLICA INDEX and ``<iter>`` the replica's BUSY
   engine-iteration count — same machinery, different clock.
+- ``lose_device`` / ``shrink_world`` — the ELASTIC drills (a host
+  preempted out of the pod, never coming back): write the reduced
+  device count (one fewer / half) to the ``TM_WORLD_FILE`` the
+  elastic supervisor probes, then die like a preemption
+  (``os._exit(137)``) — the relaunch sees a SMALLER world and must
+  continue at the new dp by resharding its checkpoint
+  (docs/RESILIENCE.md elasticity).  Fires once, persisted across
+  relaunches like every other action.
 
 A fault fires at most ONCE.  Under a supervisor the relaunched
 process would otherwise re-read the same env and re-die at the same
@@ -51,7 +59,10 @@ from pathlib import Path
 _ENV = "TM_FAULT_AT"
 _STATE_ENV = "TM_FAULT_STATE"
 
-ACTIONS = ("die", "hang", "sigterm", "corrupt_ckpt", "die_replica")
+ACTIONS = (
+    "die", "hang", "sigterm", "corrupt_ckpt", "die_replica",
+    "lose_device", "shrink_world",
+)
 
 
 class ReplicaDied(RuntimeError):
@@ -105,7 +116,8 @@ def _target() -> list[tuple[int, int, str]] | None:
                 raise ValueError(
                     f"{_ENV} must be "
                     f"'<epoch>:<iter>[:die|hang|sigterm|corrupt_ckpt"
-                    f"|die_replica][,...]', got {raw!r}"
+                    f"|die_replica|lose_device|shrink_world][,...]', "
+                    f"got {raw!r}"
                 ) from err
             if not _parsed:
                 _parsed = None
@@ -183,8 +195,43 @@ def _corrupt_latest_checkpoint(checkpoint_dir: str) -> str:
     return str(path)
 
 
+def _shrink_world(action: str, world: int | None) -> None:
+    """Write the reduced device count to ``TM_WORLD_FILE`` (the
+    elastic supervisor's probe), then die preemption-style.  The
+    baseline is the calling worker's own world when the file doesn't
+    exist yet; repeated drills compound (8 → 7 → 6 ...)."""
+    wf = os.environ.get("TM_WORLD_FILE")
+    if not wf:
+        raise RuntimeError(
+            f"{_ENV}: {action} needs TM_WORLD_FILE (set by the "
+            f"elastic supervisor — launch with elastic=... / "
+            f"tmlauncher --elastic-min-dp) so the relaunch can see "
+            f"the smaller world"
+        )
+    path = Path(wf)
+    cur = None
+    try:
+        cur = int(path.read_text().strip())
+    except (OSError, ValueError):
+        cur = None
+    if cur is None:
+        cur = world
+    if cur is None:
+        raise RuntimeError(
+            f"{_ENV}: {action} has no baseline world size — the "
+            f"worker loop must pass world= to maybe_inject_fault, or "
+            f"{wf} must already hold the device count"
+        )
+    new = cur - 1 if action == "lose_device" else max(1, cur // 2)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(f"{new}\n")
+    print(f"{_ENV}: world shrunk {cur} -> {new} ({wf})", flush=True)
+    os._exit(137)
+
+
 def _execute(action: str, epoch: int, it: int,
-             checkpoint_dir: str | None) -> None:
+             checkpoint_dir: str | None,
+             world: int | None = None) -> None:
     print(
         f"{_ENV}: injecting fault at epoch {epoch} iter {it}"
         + (f" ({action})" if action != "die" else ""),
@@ -192,6 +239,8 @@ def _execute(action: str, epoch: int, it: int,
     )
     if action == "die":
         os._exit(137)
+    if action in ("lose_device", "shrink_world"):
+        _shrink_world(action, world)
     if action == "hang":
         # a stuck collective: alive but never progressing — only a
         # stall watchdog ends this (SIGKILL; no handler could run)
@@ -227,12 +276,14 @@ def maybe_inject_fault(
     i: int,
     i_last: int | None = None,
     checkpoint_dir: str | None = None,
+    world: int | None = None,
 ) -> None:
     """Fire the first not-yet-fired fault targeting ``epoch`` and an
     iteration in ``[i, i_last]`` (``i_last`` defaults to ``i``;
     chunked dispatch loops pass the whole range so a target inside a
     multi-step chunk still fires).  ``checkpoint_dir`` feeds the
-    ``corrupt_ckpt`` action."""
+    ``corrupt_ckpt`` action; ``world`` (the caller's device count)
+    seeds the ``lose_device``/``shrink_world`` elastic drills."""
     faults = _target()
     if not faults:
         return
@@ -242,5 +293,5 @@ def maybe_inject_fault(
             continue
         if e == epoch and i <= it <= hi:
             _mark_fired(idx)
-            _execute(action, epoch, it, checkpoint_dir)
+            _execute(action, epoch, it, checkpoint_dir, world=world)
             return  # sigterm returns; one fault per boundary

@@ -168,10 +168,17 @@ class Recorder:
         resumed_iter: int | None = None,
         recovery_s: float | None = None,
         restart: int | None = None,
+        world_size: int | None = None,
+        resharded: bool | None = None,
     ) -> None:
         """One supervised relaunch: why the previous incarnation died,
         where this one resumed, and the worker-side recovery latency
-        (failure detection → restored and ready to train)."""
+        (failure detection → restored and ready to train).
+        ``world_size``/``resharded`` (elastic runs) record the DP
+        width this life trains at and whether the resume gathered +
+        re-scattered the flat exchange state — persisted through
+        ``state_dict`` so the world-size history survives further
+        checkpointed restarts."""
         self.restart_events.append({
             "restart": (
                 restart if restart is not None
@@ -181,6 +188,8 @@ class Recorder:
             "resumed_epoch": resumed_epoch,
             "resumed_iter": resumed_iter,
             "recovery_s": recovery_s,
+            "world_size": world_size,
+            "resharded": resharded,
         })
         if self.verbose:
             at = (

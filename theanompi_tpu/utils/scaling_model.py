@@ -304,6 +304,58 @@ def bucketed_overlap(
     }
 
 
+def elastic_resume_cost(
+    *,
+    param_bytes: float,
+    n_old: int,
+    n_new: int,
+    step_time_s: float,
+    optimizer: str = "adam",
+    error_feedback: bool = False,
+    host_bw: float = 2e9,
+) -> dict:
+    """Predicted cost of an ELASTIC resume (gather + re-scatter the
+    flat exchange state onto a new world, ``utils/reshard.py``) vs
+    the throughput of just continuing at the smaller world.
+
+    Bytes moved through host memory: the zero1 optimizer state at
+    fp32 master width (adam m+v = 2x the parameter bytes, momentum
+    1x), plus — with error feedback — the per-device r1 residuals
+    (``n_old`` full-width f32 buffers: each device carries its own
+    residual of the WHOLE pack) and the r2 shard residual.  Each
+    byte is read in the saved layout and written in the new one
+    (2x on the wire through ``host_bw`` — disk/DCN-limited in
+    practice, the knob to override).
+
+    The comparison the operator actually faces after losing hardware:
+    **reshard now** and train at ``n_new/n_old`` throughput, or
+    **wait** for replacement capacity at zero throughput.  Elastic
+    wins for any outage longer than ``reshard_s`` (progress starts
+    immediately after the reshard); ``reshard_steps_equiv`` prices
+    the pause in per-replica-batch steps at the old world's step
+    time."""
+    opt_mult = {"adam": 2.0, "momentum": 1.0, "sgd": 0.0}[optimizer]
+    state_bytes = opt_mult * param_bytes
+    if error_feedback:
+        # r1: n_old per-device full-width f32 residuals; r2: ONE
+        # full-width buffer (per-element shard-owner state)
+        state_bytes += n_old * param_bytes + param_bytes
+    moved = 2.0 * state_bytes          # gather + re-scatter
+    reshard_s = moved / host_bw
+    return {
+        "state_bytes": state_bytes,
+        "moved_bytes": moved,
+        "reshard_s": reshard_s,
+        "reshard_steps_equiv": (
+            reshard_s / step_time_s if step_time_s else None
+        ),
+        "throughput_frac": n_new / n_old,
+        "break_even_outage_s": reshard_s,
+        "n_old": n_old,
+        "n_new": n_new,
+    }
+
+
 def predict_table(
     *,
     step_time_1chip: float,

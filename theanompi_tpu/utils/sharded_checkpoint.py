@@ -276,6 +276,38 @@ def load_sharded_checkpoint(
     return out, meta
 
 
+def load_sharded_group(path: str | Path, group: str) -> dict[str, Any]:
+    """One group's FULL arrays (assembled from all shard files) keyed
+    by leaf path, at their SAVED global shapes — the ``.shards``
+    counterpart of ``checkpoint.load_npz_group`` for the elastic
+    resharding loader.  Coverage-checked: a leaf whose shards don't
+    tile its full shape raises instead of returning zeros."""
+    path = Path(path)
+    merged = _merged_index(path)
+    prefix = f"{group}:"
+    out: dict[str, Any] = {}
+    for k, entry in merged.items():
+        if not k.startswith(prefix):
+            continue
+        shape = tuple(entry["shape"])
+        dtype = np.dtype(entry["dtype"])
+        arr = np.zeros(shape, dtype)
+        seen = np.zeros(shape, bool)
+        for s in entry["shards"]:
+            idx = _json_to_slices(s["index"])
+            arr[idx] = _unwire(np.load(path / s["file"]), dtype)
+            seen[idx] = True
+        if shape and not seen.all():
+            raise ValueError(
+                f"checkpoint leaf {k!r}: saved shards do not cover "
+                f"the full shape {shape}"
+            )
+        out[k[len(prefix):]] = arr
+    if not out:
+        raise KeyError(f"checkpoint {path} has no group {group!r}")
+    return out
+
+
 def verify_sharded_checkpoint(path: str | Path) -> bool:
     """Deep-probe one committed ``.shards`` checkpoint: marker
     present, index fragments parse, every shard file re-hashes to its

@@ -24,6 +24,14 @@ restart from the last one" — with a HUMAN rerunning the command
   * relaunches with ``resume=True`` into the same ``checkpoint_dir``
     after exponential backoff with jitter (the retry idiom proven in
     ``parallel/center_server.py``),
+  * in **elastic** mode (``elastic=True``) probes the available
+    device count before every (re)launch (the ``.world`` file a
+    ``lose_device``/``shrink_world`` drill — or the platform — wrote)
+    and relaunches at THAT width instead of waiting for lost hardware;
+    the worker reshards its checkpoint onto the new layout
+    (``models/base.load(reshard=True)``) and the report carries the
+    ``world_size_history``.  Below ``elastic_min_dp`` it gives up
+    loudly,
   * gives up LOUDLY when ``max_restarts`` is spent or
     ``crash_loop_budget`` consecutive restarts made zero progress
     (raises ``SupervisorGaveUp`` carrying the full report — never a
@@ -39,6 +47,7 @@ supervise={...})``; drills: ``utils/faults.py``
 
 from __future__ import annotations
 
+import inspect
 import json
 import os
 import random
@@ -222,10 +231,11 @@ def restart_context() -> dict | None:
 
 
 def record_restart_into(recorder, resumed_epoch: int | None,
-                        resumed_iter: int | None) -> None:
+                        resumed_iter: int | None,
+                        resharded: bool | None = None) -> None:
     """Fold the restart context (if any) into the recorder so restart
-    cause / resumed-from / recovery latency survive in checkpoints and
-    worker summaries."""
+    cause / resumed-from / recovery latency / world size survive in
+    checkpoints and worker summaries."""
     ctx = restart_context()
     if ctx is None or recorder is None:
         return
@@ -236,6 +246,8 @@ def record_restart_into(recorder, resumed_epoch: int | None,
         resumed_iter=resumed_iter,
         recovery_s=(time.time() - t_fail) if t_fail else None,
         restart=ctx.get("restart"),
+        world_size=ctx.get("world_size"),
+        resharded=resharded,
     )
 
 
@@ -283,6 +295,7 @@ def begin_resilient_run(
         recorder,
         resumed_from[0] if resumed_from else None,
         resumed_from[1] if resumed_from else None,
+        resharded=bool(getattr(model, "resharded_from", None)) or None,
     )
     return start_iter, resumed_from
 
@@ -309,6 +322,8 @@ class RestartEvent:
     t_detect: float              # wall clock at failure detection
     resumed_from: Optional[list] = None   # [epoch, iter] after relaunch
     recovery_s: Optional[float] = None    # detection → first new progress
+    world_size: Optional[int] = None      # devices the relaunch runs at
+    resharded: Optional[bool] = None      # elastic reshard on resume
 
     def as_dict(self) -> dict:
         return dict(self.__dict__)
@@ -342,7 +357,7 @@ class Supervisor:
     the caller owns what the command looks like.
     """
 
-    cmd_for: Callable[[bool], Sequence[str]]
+    cmd_for: Callable[..., Sequence[str]]
     checkpoint_dir: str
     max_restarts: int = 5
     stall_timeout_s: float = 120.0
@@ -357,13 +372,35 @@ class Supervisor:
     env: Optional[dict] = None
     verbose: bool = True
     seed: Optional[int] = None   # pins backoff jitter (tests)
+    # -- elastic mode: resize the world instead of relaunching into it.
+    # On every (re)launch the supervisor probes the available device
+    # count (the world file — written by the platform, an operator, or
+    # a lose_device/shrink_world drill) and relaunches the worker at
+    # THAT width; the worker reshards its checkpoint onto the new
+    # layout (config['elastic'], models/base.load(reshard=True)).
+    # A probe below ``elastic_min_dp`` gives up loudly — the bound is
+    # on the available DEVICE count (== dp for every configuration
+    # the resharding loader supports; model-parallel flat packs
+    # refuse to reshard anyway, see utils/reshard.py).  Capacity
+    # returning (the file growing back, or being deleted) grows the
+    # next relaunch back automatically.
+    elastic: bool = False
+    elastic_min_dp: int = 1
+    n_devices: Optional[int] = None      # baseline world (elastic)
+    world_file: Optional[str] = None     # default {ckpt}/.world
 
     events: list = field(default_factory=list, init=False)
     proc: Optional[subprocess.Popen] = field(default=None, init=False)
+    world_history: list = field(default_factory=list, init=False)
 
     def __post_init__(self) -> None:
         if self.max_restarts < 0:
             raise ValueError("max_restarts must be >= 0")
+        if self.elastic and not self.n_devices:
+            raise ValueError(
+                "elastic supervision needs n_devices (the baseline "
+                "world size the run starts at)"
+            )
         self._rng = random.Random(self.seed)
         ckpt = Path(self.checkpoint_dir)
         ckpt.mkdir(parents=True, exist_ok=True)
@@ -371,6 +408,16 @@ class Supervisor:
             self.heartbeat_file or (ckpt / "heartbeat.json")
         )
         self._fault_state = ckpt / ".fault_state"
+        self._world_file = Path(self.world_file or (ckpt / ".world"))
+        # signature-detected ONCE (never a call-time except TypeError,
+        # which would swallow a factory's own bugs and silently
+        # relaunch at the full world)
+        try:
+            self._cmd_takes_world = "n_devices" in inspect.signature(
+                self.cmd_for
+            ).parameters
+        except (TypeError, ValueError):
+            self._cmd_takes_world = True  # uninspectable: pass it
 
     # -- internals ---------------------------------------------------------
 
@@ -378,29 +425,60 @@ class Supervisor:
         if self.verbose:
             print(f"[supervisor] {msg}", flush=True)
 
+    def _probe_world(self) -> int:
+        """Devices available for the next launch: the world file's
+        count (clamped to the baseline — hardware never grows past
+        what the run was given), else the baseline.  An unreadable /
+        nonsense file is ignored rather than trusted."""
+        try:
+            n = int(self._world_file.read_text().strip())
+        except (OSError, ValueError):
+            return int(self.n_devices or 0)
+        if n < 1:
+            return n
+        return min(n, int(self.n_devices or n))
+
     def _child_env(self, restart: int, cause: str | None,
-                   t_fail: float | None) -> dict:
+                   t_fail: float | None,
+                   world: int | None = None) -> dict:
         env = dict(self.env if self.env is not None else os.environ)
         env[HEARTBEAT_ENV] = str(self._hb_path)
         # fired faults survive relaunches (utils/faults.py) — without
         # this a TM_FAULT_AT drill would re-kill every resume forever
         env.setdefault("TM_FAULT_STATE", str(self._fault_state))
+        if self.elastic:
+            # lose_device/shrink_world drills (and platform hooks)
+            # write the shrunken device count here; the next relaunch
+            # probes it
+            env.setdefault("TM_WORLD_FILE", str(self._world_file))
         if restart > 0:
-            env[RESTART_CTX_ENV] = json.dumps(
-                {"restart": restart, "cause": cause, "t_fail": t_fail}
-            )
+            ctx = {"restart": restart, "cause": cause, "t_fail": t_fail}
+            if world is not None:
+                ctx["world_size"] = world
+            env[RESTART_CTX_ENV] = json.dumps(ctx)
         else:
             env.pop(RESTART_CTX_ENV, None)
         return env
 
     def _spawn(self, resume: bool, restart: int, cause: str | None,
                t_fail: float | None) -> subprocess.Popen:
-        cmd = list(self.cmd_for(resume))
+        world = None
+        if self.elastic:
+            world = self._probe_world()
+            self.world_history.append(world)
+            if self._cmd_takes_world:
+                cmd = list(self.cmd_for(resume, n_devices=world))
+            else:
+                # a legacy factory without the elastic parameter —
+                # world still recorded/reported, command unchanged
+                cmd = list(self.cmd_for(resume))
+        else:
+            cmd = list(self.cmd_for(resume))
         # own session: a hang is killed as a GROUP (the worker may have
         # its own children — data loader pools, center servers)
         return subprocess.Popen(
             cmd,
-            env=self._child_env(restart, cause, t_fail),
+            env=self._child_env(restart, cause, t_fail, world=world),
             start_new_session=True,
         )
 
@@ -424,6 +502,18 @@ class Supervisor:
         )
         return base * (1.0 + self.backoff_jitter * self._rng.random())
 
+    def _fold_hb_into_last_event(self, hb: dict | None) -> None:
+        """Workers stamp run-constant facts (resumed_from, and on
+        elastic runs resharded) on every boundary — attribute them to
+        the restart that caused this life, whenever they appear."""
+        if hb is None or not self.events:
+            return
+        ev = self.events[-1]
+        if hb.get("resumed_from") is not None and ev.resumed_from is None:
+            ev.resumed_from = hb["resumed_from"]
+        if hb.get("resharded") is not None and ev.resharded is None:
+            ev.resharded = bool(hb["resharded"])
+
     def _read_hb(self) -> tuple[int, float, dict | None]:
         hb = read_heartbeat(self._hb_path)
         if hb is None:
@@ -443,6 +533,20 @@ class Supervisor:
         pending: RestartEvent | None = None  # awaiting recovery proof
 
         while True:
+            if self.elastic:
+                avail = self._probe_world()
+                if avail < max(1, self.elastic_min_dp):
+                    report = self._report(
+                        completed=False, final_hb=self._read_hb()[2]
+                    )
+                    raise SupervisorGaveUp(
+                        f"supervisor: elastic world shrank to {avail} "
+                        f"device(s), below elastic_min_dp="
+                        f"{self.elastic_min_dp} — giving up (grow "
+                        f"{self._world_file} back, or delete it, to "
+                        f"resume at capacity)",
+                        report,
+                    )
             _, last_hb_time, _ = self._read_hb()
             self.proc = self._spawn(resume, restart, cause, t_fail)
             t_launch = time.monotonic()
@@ -466,13 +570,7 @@ class Supervisor:
                     # workers stamp their run-constant resumed-from on
                     # every boundary — attribute it to the restart that
                     # caused this life, whenever it first appears
-                    if (
-                        hb is not None
-                        and hb.get("resumed_from") is not None
-                        and self.events
-                        and self.events[-1].resumed_from is None
-                    ):
-                        self.events[-1].resumed_from = hb["resumed_from"]
+                    self._fold_hb_into_last_event(hb)
                     if pending is not None:
                         # recovered: the relaunched worker completed an
                         # iteration (its first boundary stamp)
@@ -500,14 +598,8 @@ class Supervisor:
             progress, _, hb = self._read_hb()
             hb_status = (hb or {}).get("status")
             cause = "hang" if hang else classify_exit(rc, hb_status)
-            if (
-                hb is not None
-                and hb.get("resumed_from") is not None
-                and self.events
-                and self.events[-1].resumed_from is None
-            ):
-                # last stamp before death carried the resume point
-                self.events[-1].resumed_from = hb["resumed_from"]
+            # last stamp before death may carry the resume point
+            self._fold_hb_into_last_event(hb)
             pending = None  # died before proving recovery: unset
 
             if cause == "clean":
@@ -552,6 +644,11 @@ class Supervisor:
                 at_progress=max(progress, 0),
                 backoff_s=delay,
                 t_detect=t_fail,
+                # the world the RELAUNCH will see (the drill/platform
+                # wrote the file before the death was detected)
+                world_size=(
+                    self._probe_world() if self.elastic else None
+                ),
             )
             self.events.append(event)
             pending = event
@@ -567,7 +664,7 @@ class Supervisor:
         recoveries = [
             e.recovery_s for e in self.events if e.recovery_s is not None
         ]
-        return {
+        report = {
             "completed": completed,
             "n_restarts": len(self.events),
             "restarts": [e.as_dict() for e in self.events],
@@ -577,6 +674,13 @@ class Supervisor:
             "final_heartbeat": final_hb,
             "checkpoint_dir": str(self.checkpoint_dir),
         }
+        if self.elastic:
+            # one entry per launch: the acceptance datum an elastic
+            # drill asserts on (e.g. [8, 4] for kill-one → shrink)
+            report["elastic"] = True
+            report["world_size_history"] = list(self.world_history)
+            report["elastic_min_dp"] = self.elastic_min_dp
+        return report
 
 
 def make_worker_cmd_factory(
@@ -585,13 +689,23 @@ def make_worker_cmd_factory(
     modelfile: str,
     modelclass: str,
     rule_kwargs: dict,
-) -> Callable[[bool], list[str]]:
+) -> Callable[..., list[str]]:
     """The launcher's spec-json child command, parameterized on
-    ``resume`` so the supervisor can flip it per relaunch."""
+    ``resume`` so the supervisor can flip it per relaunch, and on
+    ``n_devices`` so an ELASTIC supervisor can resize the world the
+    relaunch runs at (None = the original device list).  A resized
+    world is a PREFIX of the caller's device list — never devices the
+    run was not given."""
 
-    def cmd_for(resume: bool) -> list[str]:
+    def cmd_for(resume: bool, n_devices: int | None = None) -> list[str]:
+        if n_devices is None:
+            devs = list(devices) if devices is not None else None
+        elif devices is not None:
+            devs = list(devices)[: int(n_devices)]
+        else:
+            devs = list(range(int(n_devices)))
         spec = {
-            "devices": list(devices) if devices is not None else None,
+            "devices": devs,
             "modelfile": modelfile,
             "modelclass": modelclass,
             "kwargs": {**rule_kwargs, "resume": resume},

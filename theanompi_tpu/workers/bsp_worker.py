@@ -27,6 +27,146 @@ def _resolve_model(modelfile: str, modelclass: str):
     return getattr(mod, modelclass)
 
 
+ELASTIC_BATCH_POLICIES = ("global", "per_replica")
+
+
+def _peek_resume_meta(cfg: dict, checkpoint_dir: str) -> dict:
+    """Metadata of the checkpoint the resume will ACTUALLY load —
+    validated with the same setting ``model.load`` uses, so a corrupt
+    newest checkpoint (quarantined here, exactly as load() would)
+    cannot make the elastic batch/LR policy read a different world
+    than the one the restore falls back to."""
+    from theanompi_tpu.utils.checkpoint import (
+        checkpoint_meta,
+        latest_checkpoint,
+    )
+
+    path = latest_checkpoint(
+        checkpoint_dir,
+        validate=bool(cfg.get("validate_checkpoint", True)),
+    )
+    return checkpoint_meta(path) if path is not None else {}
+
+
+def _elastic_trim_devices(devices, cfg: dict, checkpoint_dir: str,
+                          verbose: bool):
+    """Fit the elastic world to the batch constraint BEFORE the mesh
+    builds: under the ``"global"`` policy the saved global batch must
+    divide the new replica count, so after e.g. ``lose_device``
+    (8 → 7) the run continues at the LARGEST width that divides it
+    (7 → dp=4, idling 3 devices) instead of crash-looping on the
+    divisibility refusal — the resize-the-world contract."""
+    if str(cfg.get("elastic_batch_policy", "global")) != "global":
+        return devices
+    meta = _peek_resume_meta(cfg, checkpoint_dir)
+    saved_global = meta.get("global_batch")
+    if saved_global is None and meta.get("world_size") \
+            and cfg.get("batch_size") is not None:
+        saved_global = int(meta["world_size"]) * int(cfg["batch_size"])
+    if not saved_global:
+        return devices
+    prod = 1
+    for k in ("tp", "sp", "pp", "ep"):
+        prod *= int(cfg.get(k, 1))
+    n_avail = len(devices) if devices is not None \
+        else len(default_devices())
+    dp_avail = n_avail // prod
+    if dp_avail < 1 or saved_global % dp_avail == 0:
+        return devices
+    dp_fit = next(
+        d for d in range(dp_avail, 0, -1) if saved_global % d == 0
+    )
+    n_use = dp_fit * prod
+    if verbose:
+        print(
+            f"elastic resume: global batch {saved_global} does not "
+            f"divide over {dp_avail} replicas — using {n_use} of "
+            f"{n_avail} available devices (dp={dp_fit})",
+            flush=True,
+        )
+    return (
+        list(devices)[:n_use] if devices is not None
+        else list(range(n_use))
+    )
+
+
+def _apply_elastic_policy(
+    cfg: dict, n_replicas: int, checkpoint_dir: str, verbose: bool
+) -> dict | None:
+    """Elastic resume across a world change: peek the newest
+    checkpoint's world stamp and rescale the batch/LR per
+    ``elastic_batch_policy`` BEFORE the model builds its pipeline.
+
+    - ``"global"`` (default): keep the GLOBAL batch — the per-replica
+      batch grows/shrinks by old_world/new_world, so the optimization
+      trajectory matches an uninterrupted equal-batch run (the batch
+      schedule is the same permutation slices; only the reduction
+      sharding changes).  Needs the global batch to divide the new
+      replica count.
+    - ``"per_replica"``: keep the per-replica batch — the global
+      batch scales with the world, and the LR linear-scales with it
+      (Goyal et al. 2017): ``lr *= new/old``, applied to ``lr`` and
+      any dict ``lr_schedule`` entries present in the config.
+
+    Returns a summary note (or None when no world change applies)."""
+    policy = str(cfg.get("elastic_batch_policy", "global"))
+    if policy not in ELASTIC_BATCH_POLICIES:
+        raise ValueError(
+            f"elastic_batch_policy must be one of "
+            f"{ELASTIC_BATCH_POLICIES} ('global' keeps the global "
+            f"batch by growing the per-replica batch; 'per_replica' "
+            f"keeps the per-replica batch and linear-scales the LR), "
+            f"got {policy!r}"
+        )
+    meta = _peek_resume_meta(cfg, checkpoint_dir)
+    saved_world = meta.get("world_size")
+    if not saved_world or int(saved_world) == n_replicas:
+        return None
+    saved_world = int(saved_world)
+    saved_global = meta.get("global_batch")
+    if saved_global is None and cfg.get("batch_size") is not None:
+        saved_global = saved_world * int(cfg["batch_size"])
+    note = {
+        "policy": policy,
+        "saved_world": saved_world,
+        "saved_global": saved_global,
+    }
+    if policy == "global":
+        if saved_global is None:
+            raise ValueError(
+                "elastic_batch_policy='global' needs the checkpoint's "
+                "global_batch stamp (pre-elastic checkpoint) or an "
+                "explicit batch_size in the config"
+            )
+        if saved_global % n_replicas:
+            raise ValueError(
+                f"elastic_batch_policy='global': global batch "
+                f"{saved_global} does not divide over the new world "
+                f"of {n_replicas} replicas — resume at a width that "
+                f"divides it, or use elastic_batch_policy="
+                f"'per_replica'"
+            )
+        cfg["batch_size"] = saved_global // n_replicas
+        note["batch_size"] = cfg["batch_size"]
+    else:
+        scale = n_replicas / float(saved_world)
+        if "lr" in cfg:
+            cfg["lr"] = float(cfg["lr"]) * scale
+        sched = cfg.get("lr_schedule")
+        if isinstance(sched, dict):
+            cfg["lr_schedule"] = {
+                k: float(v) * scale for k, v in sched.items()
+            }
+        note["lr_scale"] = scale
+    if verbose:
+        print(
+            f"elastic resume: world {saved_world} -> {n_replicas}, "
+            f"policy={policy} ({note})",
+            flush=True,
+        )
+    return note
+
+
 def _build_mesh(devices: Sequence[Any] | None, config: dict | None = None):
     """Mesh for the BSP run: remaining devices become the data axis
     after the model's parallelism knobs (``tp/sp/pp/ep`` config keys,
@@ -99,10 +239,33 @@ def run(
     # step bodies read the same rules, so summary and compile agree)
     bucket_mb = resolve_bucket_mb(cfg)
     compression, error_feedback = resolve_compression(cfg)
+    if str(cfg.get("elastic_batch_policy", "global")) \
+            not in ELASTIC_BATCH_POLICIES:
+        raise ValueError(
+            f"elastic_batch_policy must be one of "
+            f"{ELASTIC_BATCH_POLICIES}, got "
+            f"{cfg.get('elastic_batch_policy')!r}"
+        )
+    # elastic resume (config['elastic']): a relaunch at a different
+    # world width first FITS the world to the batch constraint (an
+    # odd surviving device count idles the remainder rather than
+    # crash-looping), then rescales batch/LR per elastic_batch_policy
+    # BEFORE the pipeline is sized; model.load reshards the flat
+    # exchange state onto the new layout instead of refusing
+    elastic = bool(cfg.get("elastic"))
+    if elastic and resume and checkpoint_dir:
+        devices = _elastic_trim_devices(
+            devices, cfg, checkpoint_dir, verbose
+        )
     mesh = _build_mesh(devices, cfg)
     n_replicas = dp_replicas(mesh)
+    n_devices = int(mesh.devices.size)
     if n_epochs is not None:
         cfg["n_epochs"] = n_epochs
+    elastic_note = (
+        _apply_elastic_policy(cfg, n_replicas, checkpoint_dir, verbose)
+        if elastic and resume and checkpoint_dir else None
+    )
     model = Model(cfg)
     model.build_model(n_replicas=n_replicas)
     model.compile_iter_fns(mesh=mesh, exch_strategy=strat.name)
@@ -116,8 +279,47 @@ def run(
     start_iter, resumed_from = _sup.begin_resilient_run(
         model, recorder, checkpoint_dir, resume, verbose=verbose
     )
+    resharded = getattr(model, "resharded_from", None)
+    if (
+        elastic_note and elastic_note.get("lr_scale")
+        and resumed_from is not None
+    ):
+        # load() restored the OLD world's scheduled lr from the
+        # checkpoint meta, undoing the pre-build config scaling —
+        # re-apply the linear rule to the restored value (which
+        # respects the schedule position).  Gated on an ACTUAL
+        # restore: when every checkpoint failed validation the
+        # cfg-scaled lr already stands, and rescaling again would
+        # silently square the factor.
+        model.current_lr = float(model.current_lr) * float(
+            elastic_note["lr_scale"]
+        )
+        if verbose:
+            print(
+                f"elastic resume: lr rescaled to {model.current_lr:g} "
+                f"(x{elastic_note['lr_scale']:g})",
+                flush=True,
+            )
 
     data = model.data
+    if elastic_note and start_iter and elastic_note.get("saved_global"):
+        # a mid-epoch next_iter was stamped in the OLD global-batch
+        # grid; continue at the same SAMPLE offset in the new grid
+        # (floored to a batch boundary — under the 'global' policy the
+        # grids coincide and this is the identity)
+        old_gb = int(elastic_note["saved_global"])
+        new_gb = int(data.global_batch)
+        if old_gb != new_gb:
+            rescaled = (start_iter * old_gb) // new_gb
+            if verbose and rescaled != start_iter:
+                print(
+                    f"elastic resume: mid-epoch iter {start_iter} "
+                    f"(global batch {old_gb}) -> iter {rescaled} "
+                    f"(global batch {new_gb})",
+                    flush=True,
+                )
+            start_iter = rescaled
+            resumed_from = [model.epoch, start_iter]
     if verbose:
         print(
             f"BSP: {n_replicas} replicas, {data.n_batch_train} train batches"
@@ -158,9 +360,12 @@ def run(
             i += k
             recorder.print_train_info(i - 1)
             _faults.maybe_inject_fault(epoch, i - k, i - 1,
-                                       checkpoint_dir=checkpoint_dir)
+                                       checkpoint_dir=checkpoint_dir,
+                                       world=n_devices)
             _sup.heartbeat(recorder.n_iter, epoch, i - 1,
-                           resumed_from=resumed_from)
+                           resumed_from=resumed_from,
+                           world_size=n_replicas,
+                           resharded=bool(resharded))
             if _sup.preemption_requested():
                 preempted = True
                 break
@@ -203,10 +408,12 @@ def run(
                 f"exiting cleanly", flush=True,
             )
         _sup.heartbeat(recorder.n_iter, model.epoch, i,
-                       status="preempted")
+                       status="preempted", world_size=n_replicas,
+                       resharded=bool(resharded))
     else:
         _sup.heartbeat(recorder.n_iter, model.epoch, None,
-                       status="completed")
+                       status="completed", world_size=n_replicas,
+                       resharded=bool(resharded))
     # give an in-process host its normal SIGTERM semantics back
     _sup.uninstall_preemption_handler()
 
@@ -228,6 +435,15 @@ def run(
         "restarts": recorder.restart_events,
         "n_restarts": len(recorder.restart_events),
         "mttr_s": recorder.mttr_s,
+        "world_size": n_replicas,
+        "n_devices": n_devices,
+        "elastic": elastic,
+        "elastic_batch_policy": (
+            str(cfg.get("elastic_batch_policy", "global"))
+            if elastic else None
+        ),
+        "elastic_resume": elastic_note,
+        "resharded": bool(resharded),
         "recorder": recorder,
         "model": model,
     }
