@@ -1871,6 +1871,346 @@ def bench_serving_fleet() -> dict:
     return result
 
 
+_SERVING_AUTOSCALE_CHILD = r"""
+import json, os, subprocess, sys, tempfile, time
+sys.path.insert(0, os.environ["TM_REPO"])
+import jax
+jax.config.update("jax_default_device", jax.devices("cpu")[0])
+import numpy as np
+from theanompi_tpu.models.llama import Llama
+from theanompi_tpu.parallel import make_mesh
+from theanompi_tpu.serving import Autoscaler, Router, TCPReplicaClient
+from theanompi_tpu.utils import Recorder
+
+smoke = os.environ.get("TM_SERVING_SMOKE") == "1"
+devs = jax.devices("cpu")[:8]
+cfg = dict(dim=64, n_layers=2, n_heads=4, n_kv_heads=4, ffn_dim=176,
+           vocab=512, seq_len=128, batch_size=2, lr=1e-3, seed=11,
+           compute_dtype="float32")
+# the artifact under serve is a REAL training checkpoint (same
+# protocol as every serving row): short dp=8 run
+m = Llama(cfg); m.build_model(n_replicas=8)
+m.compile_iter_fns(mesh=make_mesh(data=8, devices=devs))
+rec = Recorder(verbose=False)
+for i in range(2):
+    m.train_iter(i, rec)
+rec.flush()
+td = tempfile.mkdtemp(); m.save(td)
+
+import atexit
+import shutil
+N_CORES = os.cpu_count() or 1
+TASKSET = shutil.which("taskset")
+procs = []
+def kill_replicas():
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+atexit.register(kill_replicas)
+def spawn_replica(index, role="unified"):
+    # prefill_chunk 8: a long prompt is MANY chunks, so the unified
+    # arm's chunked-prefill interference (one chunk interleaved per
+    # decode step) is visible against this tiny model's step time
+    spec = {"config": dict(cfg, tp=1), "checkpoint": td, "paged": True,
+            "decoder": {"max_slots": 4, "max_seq": 96,
+                        "block_size": 16, "n_blocks": 48,
+                        "prefill_chunk": 8},
+            "engine": {"queue_cap": 64, "default_deadline_s": 600.0},
+            "index": index, "name": "r%d" % index, "role": role}
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu", TM_TPU_PLATFORM="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=1",
+               PALLAS_AXON_POOL_IPS="",
+               PYTHONPATH=os.environ["TM_REPO"] + os.pathsep
+               + env.get("PYTHONPATH", ""))
+    env.pop("TM_FAULT_AT", None); env.pop("TM_FAULT_STATE", None)
+    cmd = [sys.executable, "-m", "theanompi_tpu.serving.replica",
+           "--spec-json", json.dumps(spec)]
+    if TASKSET:
+        cmd = [TASKSET, "-c", str(index % N_CORES)] + cmd
+    p = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                         text=True)
+    line = p.stdout.readline()
+    assert line.startswith("REPLICA_READY"), line
+    procs.append(p)
+    return TCPReplicaClient(("127.0.0.1", int(line.split()[1])),
+                            name="r%d" % index, role=role, slots=4)
+
+rng = np.random.default_rng(0)
+def prompt(n_tok):
+    return [int(t) for t in rng.integers(1, cfg["vocab"], n_tok)]
+
+MT = 24 if smoke else 32
+ROUTER_KW = dict(fleet_queue_cap=512, default_deadline_s=600.0,
+                 replica_queue_cap=8, health_interval_s=0.02)
+
+# diurnal offered-load trace: (inter-arrival gap seconds, count)
+# phases - ramp up, plateau at a rate one replica cannot hold
+# (requests arrive ~10x faster than a 4-slot replica retires them at
+# MT decode steps each), ramp down to a trickle
+TRACE = ([(0.15, 4), (0.005, 36), (0.3, 4)] if smoke
+         else [(0.15, 8), (0.005, 56), (0.3, 6)])
+N_OFFERED = sum(c for _, c in TRACE)
+
+def run_trace(router, asc=None):
+    futs = []
+    t0 = time.perf_counter()
+    i = 0
+    for gap, count in TRACE:
+        for _ in range(count):
+            futs.append(router.submit(
+                prompt(16 + i % 8), max_tokens=MT, seed=i))
+            i += 1
+            time.sleep(gap)
+    rs = [f.result(timeout=1200.0) for f in futs]
+    if asc is not None:
+        # idle tail: give the lull hysteresis time to drain back down
+        deadline = time.monotonic() + (10.0 if smoke else 15.0)
+        while (time.monotonic() < deadline
+               and len(router.members()) > asc.min_replicas):
+            time.sleep(0.05)
+    return rs, time.perf_counter() - t0
+
+# warm standby pool: replicas spawn (and warm their executables)
+# BEFORE the trace; the autoscaler moves them in and out of the
+# FLEET, and replica-seconds counts fleet-membership time - the
+# serving-capacity metric.  (Cold-start spawning works through the
+# same factory - serve_replica_main IS the spawn - but its one-off
+# jax import + compile cost would dominate this short CPU trace.)
+n_max = 2 if smoke else 3
+pool = [spawn_replica(i) for i in range(n_max)]
+warm = Router(pool, policy="round_robin", **ROUTER_KW).start()
+wf = [warm.submit(prompt(20), max_tokens=4, seed=900 + k)
+      for k in range(2 * n_max)]
+[f.result(timeout=1200.0) for f in wf]
+warm.stop(drain_s=5.0)
+for c in pool:
+    c.reset_stats()
+
+out = {"max_tokens": MT, "n_offered": N_OFFERED, "n_max": n_max}
+
+def arm_summary(router, rs, wall, end):
+    s = router.fleet_summary()
+    return {
+        "all_ok": all(r.status == "ok" for r in rs),
+        "n_completed": s["n_completed"], "n_shed": s["n_shed"],
+        "tokens_completed": s["tokens_completed"],
+        "ttft_p50_s": s["ttft_p50_s"], "ttft_p95_s": s["ttft_p95_s"],
+        "tpot_p50_s": s["tpot_p50_s"], "tpot_p95_s": s["tpot_p95_s"],
+        "n_spawns": s["n_spawns"], "n_retires": s["n_retires"],
+        "n_requeues": s["n_requeues"],
+        "replica_seconds": router.recorder.replica_seconds(now=end),
+        "wall_s": wall,
+    }
+
+# -- arm 1: autoscaled fleet (starts at 1, bounded by n_max) ---------------
+standby = list(pool[1:])
+router = Router([pool[0]], policy="least_loaded", **ROUTER_KW).start()
+asc = Autoscaler(router, lambda i: standby.pop(0),
+                 retire=standby.append,
+                 min_replicas=1, max_replicas=n_max,
+                 scale_up_at=1.5, scale_down_at=0.2,
+                 up_hold_s=0.1, down_hold_s=1.0, cooldown_s=0.5,
+                 interval_s=0.02, verbose=True).start()
+rs, wall = run_trace(router, asc)
+asc.stop()
+end = time.monotonic()
+auto = arm_summary(router, rs, wall, end)
+auto["scale_events"] = [
+    {k: e[k] for k in ("event", "replica", "reason")}
+    for e in asc.summary()["events"]]
+router.stop(drain_s=5.0)
+out["arms"] = {"autoscaled": auto}
+# in-child asserts: the smoke satellite's bar - >=1 scale-up, >=1
+# drained scale-down, every request completes with exact tokens
+assert auto["all_ok"], auto
+assert auto["n_completed"] == N_OFFERED and auto["n_shed"] == 0, auto
+assert auto["tokens_completed"] == N_OFFERED * MT, auto
+assert auto["n_spawns"] >= 2, auto      # initial + >=1 scale-up
+assert auto["n_retires"] >= 1, auto     # >=1 drained scale-down
+for c in pool:
+    c.reset_stats()
+
+# -- arm 2: static peak-provisioned fleet (n_max replicas throughout) -----
+router = Router(pool, policy="least_loaded", **ROUTER_KW).start()
+t0 = time.monotonic()
+for c in pool:
+    router.recorder.record_spawn(c.name, t=t0, reason="static")
+rs, wall = run_trace(router)
+end = time.monotonic()
+static = arm_summary(router, rs, wall, end)
+router.stop(drain_s=5.0)
+out["arms"]["static"] = static
+assert static["all_ok"], static
+assert static["n_completed"] == N_OFFERED, static
+for c in pool:
+    c.reset_stats()
+
+# -- the headline: SLOs hold at measurably fewer replica-seconds ----------
+out["replica_seconds_saving"] = (
+    static["replica_seconds"] / auto["replica_seconds"])
+# SLOs are defined off the peak-provisioned fleet's achieved latency
+# (the best this host can do), with an absolute floor against 2-core
+# scheduler noise
+slo = {"ttft_p95_s": max(3.0 * static["ttft_p95_s"], 2.0),
+       "tpot_p95_s": max(3.0 * static["tpot_p95_s"], 0.2)}
+out["slo"] = slo
+assert auto["ttft_p95_s"] <= slo["ttft_p95_s"], out
+assert auto["tpot_p95_s"] <= slo["tpot_p95_s"], out
+if not smoke:
+    assert auto["replica_seconds"] <= 0.8 * static["replica_seconds"], out
+
+# -- disaggregation A/B: decode TPOT p95 under concurrent long
+#    prefills, unified pair vs prefill+decode specialist pair --------------
+if not smoke:
+    p0 = spawn_replica(10, role="prefill")
+    d0 = spawn_replica(11, role="decode")
+    def tpot_arm(clients):
+        router = Router(clients, policy="round_robin",
+                        **ROUTER_KW).start()
+        wf = [router.submit(prompt(20), max_tokens=4, seed=700 + k)
+              for k in range(4)]
+        [f.result(timeout=1200.0) for f in wf]     # warm this pair
+        for c in clients:
+            c.reset_stats()
+        short_futs, long_futs = [], []
+        for i in range(6):
+            for k in range(2):
+                short_futs.append(router.submit(
+                    prompt(12), max_tokens=24, seed=i * 10 + k))
+            # 3 concurrent 88-token prompts = 33 prefill chunks that
+            # a unified engine interleaves between its decode steps
+            # (vs ONE block-scatter import each on the decode
+            # specialist)
+            for k in range(3):
+                long_futs.append(router.submit(
+                    prompt(88), max_tokens=2, seed=500 + i * 10 + k))
+            time.sleep(0.3)
+        rs_s = [f.result(timeout=1200.0) for f in short_futs]
+        rs_l = [f.result(timeout=1200.0) for f in long_futs]
+        summ = router.fleet_summary()
+        router.stop(drain_s=5.0)
+        assert all(r.status == "ok" for r in rs_s + rs_l)
+        tpots = [r.tpot_s for r in rs_s if r.tpot_s]
+        return {
+            "short_tpot_p50_s": float(np.percentile(tpots, 50)),
+            "short_tpot_p95_s": float(np.percentile(tpots, 95)),
+            "n_handoffs": summ["n_handoffs"],
+        }
+    uni = tpot_arm([pool[0], pool[1]])
+    dis = tpot_arm([p0, d0])
+    out["disagg_ab"] = {
+        "unified": uni, "disagg": dis,
+        "tpot_p95_win": uni["short_tpot_p95_s"]
+        / dis["short_tpot_p95_s"],
+    }
+    assert dis["n_handoffs"] >= 6, dis
+    assert uni["n_handoffs"] == 0, uni
+    assert dis["short_tpot_p95_s"] < uni["short_tpot_p95_s"], \
+        out["disagg_ab"]
+
+kill_replicas()
+print("SERVING_AUTOSCALE " + json.dumps(out))
+"""
+
+
+def bench_serving_autoscale() -> dict:
+    """Fleet control-plane row (ISSUE 11): a diurnal offered-load
+    trace (ramp up, plateau, ramp down) over TCP replica processes,
+    served twice — once by an AUTOSCALED fleet (starts at 1 replica;
+    the ``Autoscaler`` grows it on sustained backpressure and drains
+    it back on the lull) and once by a STATIC peak-provisioned fleet.
+
+    The judged claims, asserted in-child: (1) the autoscaled fleet
+    completes every request with exact token accounting through ≥1
+    scale-up AND ≥1 drained scale-down (zero dropped requests); (2)
+    it holds the TTFT/TPOT p95 SLOs (defined off the static fleet's
+    achieved latency) at measurably FEWER replica-seconds (≤0.8× the
+    static fleet's); (3) the disaggregation A/B — decode TPOT p95 of
+    a steady short-prompt stream under concurrent long prefills is
+    LOWER on a prefill-specialist + decode-specialist pair than on a
+    unified pair of the same size (chunked-prefill interference
+    removed from the decode engine entirely)."""
+    import os
+    import subprocess
+    import sys
+
+    from theanompi_tpu.models.llama import LLAMA3_8B
+    from theanompi_tpu.utils import scaling_model as sm
+
+    env = dict(os.environ)
+    env.update(
+        TM_REPO=str(REPO),
+        TM_TPU_PLATFORM="cpu",
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+        PALLAS_AXON_POOL_IPS="",
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", _SERVING_AUTOSCALE_CHILD],
+        env=env, capture_output=True, text=True, timeout=3000,
+    )
+    rec = None
+    for line in out.stdout.splitlines():
+        if line.startswith("SERVING_AUTOSCALE "):
+            rec = json.loads(line[len("SERVING_AUTOSCALE "):])
+    if rec is None:
+        raise RuntimeError(
+            f"serving_autoscale child produced no result:\n"
+            f"{out.stdout[-1500:]}\n{out.stderr[-1500:]}"
+        )
+
+    def rounded(s: dict) -> dict:
+        return {
+            k: (round(v, 4) if isinstance(v, float) else v)
+            for k, v in s.items()
+        }
+
+    auto = rec["arms"]["autoscaled"]
+    result = {
+        "metric": (
+            "autoscaled fleet replica-seconds saving vs static "
+            "peak-provisioned fleet under a diurnal offered-load "
+            "trace, SLOs held (TCP replica processes, control-plane "
+            "spawn/drain; plus prefill/decode disaggregation TPOT "
+            "A/B)"
+        ),
+        "value": round(rec["replica_seconds_saving"], 3),
+        "unit": "x fewer replica-seconds",
+        "vs_baseline": None,
+        "arms": {k: rounded(v) for k, v in rec["arms"].items()},
+        "slo": rounded(rec["slo"]),
+        "n_offered": rec["n_offered"],
+        "max_tokens": rec["max_tokens"],
+        "scale_events": auto.get("scale_events"),
+    }
+    if "disagg_ab" in rec:
+        result["disagg_ab"] = {
+            "unified": rounded(rec["disagg_ab"]["unified"]),
+            "disagg": rounded(rec["disagg_ab"]["disagg"]),
+            "tpot_p95_win": round(rec["disagg_ab"]["tpot_p95_win"], 3),
+        }
+    fr = sm.fleet_roofline(
+        LLAMA3_8B, offered_tokens_per_sec=20000, context=1024, tp=8,
+        batch=8,
+    )
+    result["predicted_v5e_8b_tp8_knee"] = {
+        "knee_replicas_at_20k_offered": fr["knee_replicas"],
+        "target_util": fr["target_util"],
+    }
+    result["scale_note"] = (
+        "2-core CPU host - absolute latencies are CPU-bound; the "
+        "control-plane mechanics (pressure signal, hysteresis, "
+        "warm-pool spawn, drain-with-requeue, replica-seconds "
+        "ledger, KV handoff) are platform-independent.  The "
+        "autoscaler's scale_up/scale_down thresholds bracket the "
+        "fleet_roofline knee (utilization at target_util of a "
+        "replica's capacity); predicted_v5e_8b_tp8_knee is where "
+        "that knee sits for the 8B config on real chips"
+    )
+    return result
+
+
 def bench_easgd() -> dict:
     """BASELINE config 3: WRN-28-10 under the EASGD rule's exchange
     cadence, on the real chip — the async rules' first captured COST
@@ -2228,6 +2568,7 @@ BENCHES = {
     "serving": lambda **kw: bench_serving(),
     "serving_paged": lambda **kw: bench_serving_paged(),
     "serving_fleet": lambda **kw: bench_serving_fleet(),
+    "serving_autoscale": lambda **kw: bench_serving_autoscale(),
     "loader": lambda **kw: bench_loader(),
     "loader_train": lambda **kw: bench_loader_train(),
     "easgd": lambda **kw: bench_easgd(),
@@ -2289,7 +2630,8 @@ def main() -> None:
     # leftover); serving_fleet is the multi-replica router row
     for name in ("wresnet", "llama", "alexnet", "vgg16", "googlenet",
                  "zero1", "bucketed", "compressed", "serving",
-                 "serving_paged", "serving_fleet", "loader",
+                 "serving_paged", "serving_fleet",
+                 "serving_autoscale", "loader",
                  "loader_train", "easgd", "gosgd"):
         # two attempts: the tunneled remote-compile service drops a
         # response now and then (observed: "response body closed

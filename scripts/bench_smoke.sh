@@ -26,6 +26,11 @@
 #    its 8-device world mid-run and completes at 4 after a resharded
 #    resume; asserts resumed progress and the [8, 4] world-size
 #    history in the supervisor report (docs/RESILIENCE.md).
+# 6. serving_autoscale: the control-plane row in smoke shape — a
+#    short diurnal ramp over 2 TCP replica processes behind the
+#    autoscaler; asserts ≥1 scale-up AND ≥1 drained scale-down with
+#    every request completing under exact token accounting (zero
+#    dropped across the membership changes), SLOs held.
 #
 # Usage: bash scripts/bench_smoke.sh
 
@@ -169,3 +174,25 @@ if len(losses) != n_epochs * nb:
              % (len(losses), n_epochs * nb))
 print("bench_smoke: elastic shrink-resume OK")
 PYEOF
+
+# 6. serving_autoscale: control-plane smoke — short diurnal ramp over
+#    2 TCP replica processes; the child itself asserts exact token
+#    accounting and SLOs, this gate re-asserts the membership churn
+#    (≥1 scale-up, ≥1 drained scale-down, zero sheds).
+out=$(TM_SERVING_SMOKE=1 TM_BENCH_MODEL=serving_autoscale python bench.py)
+printf '%s\n' "$out" | python -c '
+import json, sys
+row = json.loads(sys.stdin.readline())
+auto = row["arms"]["autoscaled"]
+print("autoscale saving", row.get("value"),
+      "spawns", auto.get("n_spawns"), "retires", auto.get("n_retires"),
+      "events", auto.get("scale_events"))
+if not auto["all_ok"] or auto["n_shed"] != 0:
+    sys.exit("bench_smoke: autoscale arm shed/failed requests: %s" % auto)
+if auto["tokens_completed"] != auto["n_completed"] * row["max_tokens"]:
+    sys.exit("bench_smoke: autoscale token accounting off: %s" % auto)
+if not (auto["n_spawns"] >= 2 and auto["n_retires"] >= 1):
+    sys.exit("bench_smoke: autoscale arm saw no scale-up+drained "
+             "scale-down: %s" % auto)
+print("bench_smoke: serving_autoscale OK")
+'

@@ -2,8 +2,10 @@
 # Fault x recovery matrix — the deterministic self-healing grid
 # (docs/RESILIENCE.md): die / hang / sigterm / corrupt_ckpt faults
 # against npz / .shards checkpoints, driven through one supervised
-# launch() each, plus the fast resilience units and the elastic
-# world-resize arm (lose_device/shrink_world -> resharded resume).
+# launch() each, plus the fast resilience units, the elastic
+# world-resize arm (lose_device/shrink_world -> resharded resume),
+# and the serving control-plane arm (die_replica on a prefill
+# specialist mid-handoff, spike_load autoscaler drill).
 #
 # Runs ALONGSIDE scripts/tier1.sh, not inside it: the end-to-end
 # cells are marked `slow` (each is a multi-process training drill) so
@@ -34,6 +36,16 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu \
 # cheap, and the layer every elastic drill below depends on
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
     python -m pytest tests/test_reshard.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly "$@" \
+    2>&1 | tee -a /tmp/_fm.log || exit $?
+
+# serving control-plane arm: the fleet drills that ride the SAME
+# TM_FAULT_AT machinery — die_replica killing a prefill specialist
+# mid-handoff (token-exact requeue), spike_load forcing an
+# autoscaler scale-up, drained scale-down losing nothing
+timeout -k 10 870 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_disaggregation.py \
+    tests/test_autoscaler.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly "$@" \
     2>&1 | tee -a /tmp/_fm.log || exit $?
 

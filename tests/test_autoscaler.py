@@ -1,0 +1,517 @@
+"""Fleet autoscaling control plane (theanompi_tpu/serving/
+autoscaler.py) + replica-seconds accounting
+(utils/recorder.FleetRecorder).
+
+The contract under test:
+
+- POLICY: scale-up fires only on SUSTAINED backpressure (hysteresis
+  hold + cooldown), bounded by ``max_replicas``; scale-down drains
+  the least-loaded managed member after a sustained lull, bounded by
+  ``min_replicas``; thresholds validate at construction.
+- DRAIN: ``Router.drain_replica`` requeues the victim's queued and
+  in-flight work through the failover path WITHOUT charging the
+  requests' failover budget — a scale-down can never shed a request
+  "failover"; ``remove_replica`` pulls the victim's final telemetry
+  snapshot so merged fleet counts stay conserved across the
+  membership change.
+- ACCOUNTING: ``FleetRecorder.replica_seconds`` integrates the
+  spawn/retire event log exactly (multiple lives per name, open
+  lives closing at ``now``) and the summary's counts agree with the
+  log.
+- DRILL: the ``spike_load`` fault fires on the autoscaler's own
+  (index, tick) clock and forces an immediate scale-up.
+- END TO END (real engines): a flooded 1-replica fleet scales up,
+  completes every request with exact token accounting, then drains
+  back down when idle.
+"""
+
+import time
+
+import pytest
+
+from theanompi_tpu.models.llama import Llama
+from theanompi_tpu.parallel import make_mesh
+from theanompi_tpu.serving import (
+    Autoscaler,
+    Engine,
+    InProcessReplica,
+    Router,
+)
+from theanompi_tpu.serving.engine import Result, ServingFuture
+from theanompi_tpu.utils import FleetRecorder, ServingRecorder
+from theanompi_tpu.utils.faults import reset_fault_cache
+
+pytestmark = pytest.mark.serving
+
+
+class FakeReplica:
+    """Scripted replica: futures resolve when the test says so; load
+    is the count of unresolved submits; completions land in a real
+    ServingRecorder so the conservation tests see honest state."""
+
+    def __init__(self, name, slots=2):
+        self.name = name
+        self._slots = int(slots)
+        self._alive = True
+        self._hb = {"progress": 0, "time": 0.0, "status": "running"}
+        self.submitted = []
+        self.recorder = ServingRecorder(max_slots=slots)
+        self.role = "unified"
+
+    def beat(self):
+        self._hb = {
+            "progress": self._hb["progress"] + 1,
+            "time": time.time(), "status": "running",
+        }
+
+    def submit(self, request):
+        fut = ServingFuture()
+        self.submitted.append((request, fut))
+        return fut
+
+    def resolve_all(self, n_tokens=2):
+        for req, fut in self.submitted:
+            if not fut.done():
+                fut._set(Result(
+                    status="ok", finish_reason="max_tokens",
+                    tokens=list(range(n_tokens)), ttft_s=0.01,
+                    tpot_s=0.001, e2e_s=0.02,
+                ))
+                self.recorder.record_request(
+                    status="ok", finish_reason="max_tokens",
+                    n_prompt=len(req.prompt), n_generated=n_tokens,
+                    ttft_s=0.01, tpot_s=0.001, e2e_s=0.02,
+                )
+
+    def load(self):
+        return sum(not f.done() for _, f in self.submitted)
+
+    def slots(self):
+        return self._slots
+
+    def heartbeat(self):
+        return dict(self._hb)
+
+    def alive(self):
+        return self._alive
+
+    def recorder_state(self):
+        return self.recorder.state_dict()
+
+    def paging_stats(self):
+        return None
+
+
+def fake_router(fakes, **kw):
+    kw.setdefault("policy", "least_loaded")
+    kw.setdefault("startup_grace_s", 60.0)
+    kw.setdefault("replica_queue_cap", None)
+    r = Router(fakes, **kw)
+    for f in fakes:
+        f.beat()
+    r.check_health()
+    return r
+
+
+def spawner(spawned):
+    def spawn(i):
+        f = FakeReplica(f"auto{i}")
+        f.beat()
+        spawned.append(f)
+        return f
+    return spawn
+
+
+class TestPolicy:
+    def test_scale_up_on_sustained_pressure_only(self):
+        fakes = [FakeReplica("a")]
+        r = fake_router(fakes)
+        spawned = []
+        asc = Autoscaler(
+            r, spawner(spawned), max_replicas=3,
+            scale_up_at=1.5, up_hold_s=0.1, cooldown_s=0.0,
+        )
+        for _ in range(6):
+            r.submit([1, 2], max_tokens=2)
+        assert asc.tick() == 3.0        # 6 outstanding / 2 slots
+        assert not spawned              # blip: hold not yet served
+        time.sleep(0.12)
+        asc.tick()
+        assert len(spawned) == 1        # sustained: acts
+        r.check_health()
+        # pressure 6/4 == 1.5 still >= threshold, but the hold
+        # restarts after an action
+        asc.tick()
+        assert len(spawned) == 1
+        time.sleep(0.12)
+        asc.tick()
+        assert len(spawned) == 2
+        r.check_health()
+        time.sleep(0.12)
+        asc.tick()                      # 6/6 = 1.0 < 1.5: stable
+        assert len(spawned) == 2
+        for f in fakes + spawned:
+            f.resolve_all()
+
+    def test_max_replicas_bounds_growth(self):
+        fakes = [FakeReplica("a")]
+        r = fake_router(fakes)
+        spawned = []
+        asc = Autoscaler(
+            r, spawner(spawned), max_replicas=2,
+            up_hold_s=0.0, cooldown_s=0.0,
+        )
+        for _ in range(50):
+            r.submit([1], max_tokens=2)
+        for _ in range(5):
+            asc.tick()
+            r.check_health()
+        assert len(spawned) == 1        # 1 initial + 1 = max 2
+        for f in fakes + spawned:
+            f.resolve_all()
+
+    def test_scale_down_after_lull_respects_min(self):
+        fakes = [FakeReplica("a"), FakeReplica("b"),
+                 FakeReplica("c")]
+        r = fake_router(fakes)
+        asc = Autoscaler(
+            r, spawner([]), min_replicas=2,
+            scale_down_at=0.25, down_hold_s=0.05, cooldown_s=0.0,
+        )
+        asc.tick()                      # pressure 0: lull starts
+        time.sleep(0.06)
+        asc.tick()
+        assert len(r.members()) == 2    # one retired
+        assert r.recorder.summary()["n_retires"] == 1
+        time.sleep(0.06)
+        asc.tick()
+        time.sleep(0.06)
+        asc.tick()
+        assert len(r.members()) == 2    # min_replicas floor holds
+
+    def test_victim_is_least_loaded(self):
+        a, b = FakeReplica("a"), FakeReplica("b")
+        r = fake_router([a, b], policy="round_robin")
+        for _ in range(3):
+            r.submit([1], max_tokens=2)   # a:2, b:1 (round robin)
+        asc = Autoscaler(
+            r, spawner([]), min_replicas=1,
+            scale_down_at=10.0, scale_up_at=11.0,  # force lull
+            down_hold_s=0.0, cooldown_s=0.0,
+        )
+        asc.tick()
+        assert set(r.members()) == {"a"}   # b had less load
+        a.resolve_all()
+        b.resolve_all()
+
+    def test_threshold_validation(self):
+        r = fake_router([FakeReplica("a")])
+        with pytest.raises(ValueError, match="scale_down_at"):
+            Autoscaler(r, spawner([]), scale_up_at=0.5,
+                       scale_down_at=0.8)
+        with pytest.raises(ValueError, match="min_replicas"):
+            Autoscaler(r, spawner([]), min_replicas=3,
+                       max_replicas=2)
+
+    def test_failing_spawn_kills_loop_loudly(self):
+        """Supervisor discipline for the control plane itself: a
+        spawn factory that raises must not silently end autoscaling
+        — the loop records dead + cause (the replica-loop
+        contract)."""
+        fakes = [FakeReplica("a")]
+        r = fake_router(fakes)
+
+        def bad_spawn(i):
+            raise RuntimeError("replica launch failed")
+
+        asc = Autoscaler(r, bad_spawn, max_replicas=3,
+                         up_hold_s=0.0, cooldown_s=0.0,
+                         interval_s=0.005)
+        for _ in range(8):
+            r.submit([1], max_tokens=2)
+        asc.start()
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and not asc.dead:
+            time.sleep(0.01)
+        asc.stop()
+        assert asc.dead
+        assert "replica launch failed" in asc.death_cause
+        assert asc.summary()["dead"] is True
+        fakes[0].resolve_all()
+
+    def test_dead_managed_member_frees_scale_budget(self):
+        """A dead managed replica must not consume max_replicas
+        budget: its replacement scale-up must still fire (and the
+        min_replicas floor must not be propped up by corpses)."""
+        a, b = FakeReplica("a"), FakeReplica("b")
+        r = fake_router([a, b])
+        spawned = []
+        asc = Autoscaler(
+            r, spawner(spawned), min_replicas=1, max_replicas=2,
+            up_hold_s=0.0, cooldown_s=0.0,
+        )
+        a._alive = False
+        r.check_health()          # a is now an unhealthy corpse
+        for _ in range(10):
+            r.submit([1], max_tokens=2)
+        asc.tick()                # budget: 1 healthy managed < 2
+        assert len(spawned) == 1, (spawned, asc.summary())
+        b.resolve_all()
+        spawned[0].resolve_all()
+        r._pump_queue()
+        b.resolve_all()
+        spawned[0].resolve_all()
+
+    def test_explicit_add_replica_role_is_pinned(self):
+        """A role passed explicitly to add_replica must survive the
+        watchdog's role-convergence pass (which exists for TCP
+        clients registered before their first pong)."""
+        a = FakeReplica("a")        # .role attribute is "unified"
+        r = Router([], startup_grace_s=60.0)
+        r.add_replica(a, role="prefill")
+        a.beat()
+        r.check_health()
+        assert r.members()["a"]["role"] == "prefill"
+
+    def test_member_role_converges_with_replica(self):
+        """A TCP client registered before its first pong carries the
+        caller's default role; once the pong corrects the client,
+        the watchdog must carry the correction into dispatch
+        (_Member.role), not leave it on the client object."""
+        a = FakeReplica("a")
+        r = fake_router([a])
+        assert r.members()["a"]["role"] == "unified"
+        a.role = "prefill"       # the pong's correction
+        r.check_health()
+        assert r.members()["a"]["role"] == "prefill"
+
+    def test_spike_load_drill_bypasses_hysteresis(self, monkeypatch):
+        reset_fault_cache()
+        monkeypatch.setenv("TM_FAULT_AT", "9:2:spike_load")
+        try:
+            fakes = [FakeReplica("a")]
+            r = fake_router(fakes)
+            spawned = []
+            asc = Autoscaler(
+                r, spawner(spawned), index=9, max_replicas=3,
+                up_hold_s=600.0, cooldown_s=600.0,  # would block
+            )
+            asc.tick()                  # tick 1: no fault
+            assert not spawned
+            asc.tick()                  # tick 2: spike fires
+            assert len(spawned) == 1
+            assert asc.events[-1]["reason"] == "spike_load drill"
+            asc.tick()                  # fired once only
+            assert len(spawned) == 1
+        finally:
+            reset_fault_cache()
+
+
+class TestSaturatedSpecialistFallback:
+    def test_saturated_prefill_pool_falls_back_to_unified(self):
+        """Role purity yields to availability for LOAD too: when
+        every prefill specialist is past replica_queue_cap, the
+        request serves end-to-end on a unified member instead of
+        waiting at the router toward a deadline shed."""
+        pre = FakeReplica("p0")
+        pre.role = "prefill"
+        uni = FakeReplica("u0")
+        r = fake_router([pre, uni], policy="round_robin",
+                        replica_queue_cap=2)
+        # saturate the prefiller
+        for _ in range(2):
+            r.submit([1, 2], max_tokens=4)
+        assert len(pre.submitted) == 2
+        fut = r.submit([3, 4], max_tokens=4)
+        assert len(uni.submitted) == 1          # spilled, not held
+        req = uni.submitted[0][0]
+        assert not req.prefill_only             # end-to-end service
+        uni.resolve_all()
+        assert fut.result(timeout=1.0).status == "ok"
+        pre.resolve_all()
+
+
+class TestDrain:
+    def test_drain_requeues_without_charging_budget(self):
+        """max_requeues=0: ONE failover would shed the request, but a
+        scale-down drain is the fleet's choice — uncharged, the
+        request survives the move."""
+        a, b = FakeReplica("a"), FakeReplica("b")
+        r = fake_router([a, b], policy="round_robin", max_requeues=0)
+        fut = r.submit([1, 2], max_tokens=2)
+        assert len(a.submitted) == 1
+        n = r.drain_replica("a")
+        assert n == 1
+        r._pump_queue()
+        assert len(b.submitted) == 1    # moved, not shed
+        b.resolve_all()
+        assert fut.result(timeout=1.0).status == "ok"
+        assert r.recorder.n_requeues == 1   # still observable
+
+    def test_draining_member_takes_no_new_work(self):
+        a, b = FakeReplica("a"), FakeReplica("b")
+        r = fake_router([a, b], policy="round_robin")
+        r.drain_replica("a")
+        for _ in range(4):
+            r.submit([1], max_tokens=2)
+        assert len(a.submitted) == 0
+        assert len(b.submitted) == 4
+        assert r.members()["a"]["draining"] is True
+        b.resolve_all()
+
+    def test_remove_unknown_replica_raises(self):
+        r = fake_router([FakeReplica("a")])
+        with pytest.raises(KeyError, match="nope"):
+            r.remove_replica("nope")
+
+    def test_remove_snapshots_final_telemetry_conserving_counts(self):
+        """The conservation bar: after a membership change, the
+        merged fleet telemetry still accounts for every request the
+        retired member served."""
+        a, b = FakeReplica("a"), FakeReplica("b")
+        r = fake_router([a, b], policy="round_robin")
+        futs = [r.submit([1, 2], max_tokens=2) for _ in range(6)]
+        a.resolve_all()
+        b.resolve_all()
+        assert all(f.result(timeout=1.0).status == "ok" for f in futs)
+        r.remove_replica("a")
+        s = r.fleet_summary()
+        assert "a" not in s["members"]
+        # router-side stream conserved...
+        assert s["n_completed"] == 6
+        # ...and the retired member's replica-side view too
+        assert s["per_replica"]["a"]["n_completed"] == 3
+        assert s["per_replica"]["b"]["n_completed"] == 3
+        total = sum(
+            p["n_completed"] for p in s["per_replica"].values()
+        )
+        assert total == s["n_completed"]
+
+
+class TestReplicaSeconds:
+    def test_event_log_integration_exact(self):
+        fr = FleetRecorder()
+        fr.record_spawn("a", t=0.0)
+        fr.record_spawn("b", t=10.0)
+        fr.record_retire("b", t=30.0)     # life 1 of b: 20s
+        fr.record_spawn("b", t=50.0)      # second life
+        fr.record_retire("b", t=55.0)     # +5s
+        assert fr.replica_seconds(now=100.0) == 100.0 + 25.0
+        s = fr.summary()
+        assert s["n_spawns"] == 3 and s["n_retires"] == 2
+        assert s["replica_seconds"] is not None
+
+    def test_unmatched_retire_and_empty_log(self):
+        fr = FleetRecorder()
+        assert fr.replica_seconds(now=5.0) == 0.0
+        assert fr.summary()["replica_seconds"] is None
+        fr.record_retire("ghost", t=1.0)   # no spawn: ignored
+        assert fr.replica_seconds(now=5.0) == 0.0
+
+    def test_autoscaler_events_match_recorder_log(self):
+        fakes = [FakeReplica("a")]
+        r = fake_router(fakes)
+        spawned = []
+        asc = Autoscaler(
+            r, spawner(spawned), min_replicas=1, max_replicas=3,
+            up_hold_s=0.0, down_hold_s=0.0, cooldown_s=0.0,
+        )
+        for _ in range(10):
+            r.submit([1], max_tokens=2)
+        asc.tick()
+        r.check_health()
+        asc.tick()
+        for f in fakes + spawned:
+            f.resolve_all()
+        r._pump_queue()
+        for f in fakes + spawned:
+            f.resolve_all()
+        asc.tick()
+        asc.tick()
+        ev = r.recorder.scale_events
+        # initial spawn + every autoscaler action is in the log, in
+        # order, and the summaries agree
+        assert [e["event"] for e in ev] == (
+            ["spawn"] + [e["event"] for e in asc.events]
+        )
+        s = asc.summary()
+        assert s["n_scale_ups"] == 2 and s["n_scale_downs"] == 2
+        fs = r.recorder.summary()
+        assert fs["n_spawns"] == 3 and fs["n_retires"] == 2
+        # every life the log opened is either closed or still serving
+        assert fs["replica_seconds"] > 0.0
+
+
+SMALL = dict(
+    dim=32, n_layers=2, n_heads=4, n_kv_heads=2, ffn_dim=64,
+    vocab=64, seq_len=64, batch_size=4, lr=1e-2,
+    n_train=64, n_val=32, compute_dtype="float32", remat=False,
+)
+
+
+class TestAutoscaleE2E:
+    def test_flood_scales_up_serves_exactly_then_drains(
+        self, devices8
+    ):
+        """Real engines: a 1-replica fleet floods past its slots, the
+        autoscaler adds a second replica mid-burst, every request
+        completes with exact token accounting, and the idle fleet
+        drains back to one member with the retire in the event
+        log."""
+        def build():
+            m = Llama(dict(SMALL, tp=1))
+            m.build_model(n_replicas=1)
+            m.compile_iter_fns(
+                mesh=make_mesh(data=1, model=1,
+                               devices=devices8[:1])
+            )
+            return m.make_decoder(
+                paged=True, max_slots=2, max_seq=48, block_size=8,
+                prefill_chunk=8,
+            )
+
+        standby = InProcessReplica(Engine(build()), name="r1",
+                                   index=1)
+        r0 = InProcessReplica(Engine(build()), name="r0").start()
+        router = Router(
+            [r0], policy="least_loaded", health_interval_s=0.005,
+            startup_grace_s=120.0, replica_queue_cap=4,
+        ).start()
+
+        def spawn(i):
+            return standby.start()
+
+        asc = Autoscaler(
+            router, spawn, min_replicas=1, max_replicas=2,
+            scale_up_at=2.0, scale_down_at=0.2,
+            up_hold_s=0.0, down_hold_s=0.05, cooldown_s=0.0,
+        )
+        try:
+            n, mt = 10, 4
+            futs = [
+                router.submit([1 + i, 5, 9, 3, 17], max_tokens=mt,
+                              seed=i)
+                for i in range(n)
+            ]
+            asc.tick()                 # pressure 10/2 = 5: scale up
+            assert "r1" in router.members()
+            rs = [f.result(timeout=240.0) for f in futs]
+            assert all(x.status == "ok" for x in rs)
+            assert sum(len(x.tokens) for x in rs) == n * mt
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline and \
+                    len(router.members()) > 1:
+                asc.tick()
+                time.sleep(0.01)
+            assert len(router.members()) == 1
+            summ = router.fleet_summary()
+            assert summ["n_completed"] == n
+            assert summ["n_spawns"] == 2 and summ["n_retires"] == 1
+            assert summ["replica_seconds"] > 0.0
+            # both replicas actually served
+            assert summ["dispatched"]["r0"] >= 1
+            assert summ["dispatched"]["r1"] >= 1
+        finally:
+            router.stop(drain_s=5.0)
+            r0.stop()
+            standby.stop()

@@ -395,3 +395,126 @@ def test_elastic_resume_cost():
     # sgd has no optimizer state but EF still moves bytes
     sgd = elastic_resume_cost(**base, optimizer="sgd")
     assert sgd["state_bytes"] == 0 and sgd["reshard_s"] == 0
+
+
+# ---------------------------------------------------------------------------
+# measured anchor: bsp_efficiency vs trace_comm on real BSP runs
+# (ROADMAP 3c / VERDICT #6 — the predictor family the fleet/elastic/
+# autoscaler items lean on gets one measured data point)
+# ---------------------------------------------------------------------------
+
+
+def _measure_bsp_world(n: int, devices) -> dict:
+    """One BSP training run at data-parallel width ``n`` on the
+    virtual CPU mesh, with a ``trace_comm`` collective attribution
+    of K fenced steps."""
+    import jax
+
+    from theanompi_tpu.models.llama import Llama
+    from theanompi_tpu.parallel import make_mesh
+    from theanompi_tpu.utils import Recorder
+    from theanompi_tpu.utils.trace_comm import report_of
+
+    cfg = dict(
+        dim=64, n_layers=2, n_heads=4, n_kv_heads=4, ffn_dim=176,
+        vocab=512, seq_len=128, batch_size=2, lr=1e-3, seed=3,
+        compute_dtype="float32",
+    )
+    m = Llama(cfg)
+    m.build_model(n_replicas=n)
+    m.compile_iter_fns(mesh=make_mesh(data=n, devices=devices[:n]))
+    rec = Recorder(verbose=False)
+    for i in range(3):
+        m.train_iter(i, rec)
+    rec.flush()                  # warmup fence (compiles done)
+    k = 10
+
+    def steps():
+        for i in range(k):
+            m.train_iter(100 + i, rec)
+        rec.flush()              # reading the losses IS the fence
+
+    rep = report_of(steps)
+    return {
+        "n": n, "k_steps": k, "trace": rep,
+        "param_bytes": 4 * sum(
+            x.size for x in jax.tree_util.tree_leaves(m.params)
+        ),
+    }
+
+
+@pytest.mark.slow
+def test_bsp_efficiency_measured_anchor(devices8):
+    """Validate ``bsp_efficiency`` against ``trace_comm``-measured
+    BSP runs at worlds of 1/2/4 on this host (ROADMAP 3c /
+    VERDICT #6).
+
+    This image's 0.4.x-shimmed jax refuses multi-PROCESS XLA
+    computations on the CPU backend ("Multiprocess computations
+    aren't implemented" — the same refusal that fails
+    ``test_distributed``'s slow two-process drill here), so the
+    measured worlds are the repo's standard stand-in: the virtual
+    CPU mesh at 1/2/4 devices, which dispatches the IDENTICAL XLA
+    collectives (``TestRealCollectives`` proves they are trace-
+    attributable on this mesh).  On hardware the same protocol runs
+    over real processes unchanged.
+
+    Protocol: each world runs the same tiny-Llama BSP config
+    (per-replica batch constant — weak scaling) and captures a
+    profiler trace of K fenced steps.  The n=2 run CALIBRATES the
+    effective exchange bandwidth (ring bytes over measured
+    collective seconds — the one anchor a datasheet ChipSpec cannot
+    provide for this wire); the predictor then PREDICTS the n=4
+    efficiency from that calibration, and the prediction must land
+    within ±0.25 ABSOLUTE efficiency of the n=4 run's own measured
+    value.  The tolerance is stated wide on purpose: the virtual
+    mesh shares 2 physical cores, so collective stalls carry
+    scheduler jitter — the anchor validates the predictor's FORM
+    (wire term scaling 2*B*(n-1)/n, efficiency composition) to
+    first order, not datasheet precision.  ``overlap_frac=0``
+    matches the serial-tail efficiency ``1 - comm_frac`` the trace
+    measures (the overlap term is separately exercised by the
+    bucketed-exchange trace tests)."""
+    m1 = _measure_bsp_world(1, devices8)
+    m2 = _measure_bsp_world(2, devices8)
+    m4 = _measure_bsp_world(4, devices8)
+
+    # n=1: no collective to expose — efficiency is structurally 1
+    t1 = m1["trace"]
+    assert t1["comm_frac"] < 0.05, t1
+
+    def per_step(rec, key):
+        t = rec["trace"]
+        return t[key] / max(1, t["n_cores"]) / rec["k_steps"]
+
+    pb = m4["param_bytes"]
+    assert pb == m2["param_bytes"]
+
+    # calibrate the wire from n=2: allreduce_time's ring formula
+    # inverted on the measured per-step collective seconds
+    t_coll_2 = per_step(m2, "collective_s")
+    assert t_coll_2 > 0, m2
+    bw = (2.0 * pb * (2 - 1) / 2) / t_coll_2
+
+    # predict n=4 from the calibration + n=4's own compute time
+    t_comp_4 = per_step(m4, "device_busy_s") - per_step(
+        m4, "collective_s"
+    )
+    assert t_comp_4 > 0, m4
+    pred = bsp_efficiency(
+        step_time_1chip=t_comp_4, param_bytes=pb, n_chips=4,
+        overlap_frac=0.0, bw=bw,
+    )
+    eff_pred = pred["efficiency_no_overlap"]
+    eff_meas = 1.0 - m4["trace"]["comm_frac"]
+    assert 0.0 < eff_meas <= 1.0
+    tol = 0.25
+    assert abs(eff_pred - eff_meas) <= tol, (
+        f"predicted BSP efficiency {eff_pred:.3f} vs measured "
+        f"{eff_meas:.3f} at n=4 (calibrated bw {bw / 1e6:.1f} MB/s "
+        f"from n=2) — outside +/-{tol}"
+    )
+    # and the directional law the autoscaler's fleet_roofline leans
+    # on: efficiency does not improve as the world grows
+    eff_meas_2 = 1.0 - m2["trace"]["comm_frac"]
+    assert eff_meas <= eff_meas_2 + 0.10, (eff_meas, eff_meas_2)

@@ -24,9 +24,22 @@ traffic" workload): KV-cache decode for Llama + a slot-based engine.
   replica's queued AND in-flight requests to healthy members (every
   future still resolves), and aggregates telemetry through
   ``utils.recorder.FleetRecorder``.
+- ``kv_transfer`` — disaggregated prefill/decode (v4): the portable
+  KV handoff record a prefill-specialist replica ships to a
+  decode-specialist (tp-layout-free; ``BlockManager`` tables are the
+  receive substrate), with role-aware dispatch in the router and a
+  unified fallback when no specialist is healthy.
+- ``autoscaler`` — the control plane (v4): a supervisor-style policy
+  loop that watches router backpressure against the fleet's slot
+  capacity and spawns/retires replicas with hysteresis; scale-down
+  drains through the failover path (never drops a request), and
+  spawn/retire events feed ``FleetRecorder.replica_seconds`` — the
+  cost metric of the ``serving_autoscale`` bench.
 
 See docs/SERVING.md for lifecycle, knobs and telemetry.
 """
+
+from theanompi_tpu.serving.autoscaler import Autoscaler
 
 from theanompi_tpu.serving.blocks import (
     BlockAllocator,
@@ -45,6 +58,11 @@ from theanompi_tpu.serving.engine import (
     Result,
     ServingFuture,
 )
+from theanompi_tpu.serving.kv_transfer import (
+    build_handoff,
+    handoff_bytes,
+    inject_handoff,
+)
 from theanompi_tpu.serving.prefix_cache import PrefixCache
 from theanompi_tpu.serving.replica import (
     InProcessReplica,
@@ -59,6 +77,7 @@ from theanompi_tpu.serving.router import (
 )
 
 __all__ = [
+    "Autoscaler",
     "BlockAllocator",
     "BlockManager",
     "ConsistentHashRing",
@@ -75,7 +94,10 @@ __all__ = [
     "Router",
     "ServingFuture",
     "TCPReplicaClient",
+    "build_handoff",
     "decoder_from_checkpoint",
     "default_prefill_buckets",
+    "handoff_bytes",
+    "inject_handoff",
     "prefix_affinity_key",
 ]

@@ -562,6 +562,8 @@ class PagedLlamaDecoder(LlamaDecoder):
                  self.block_size, self._hd)
         self.pools = self._zeros_cache(shape)
         self._copy_fn = None
+        self._xfer_gather_fn = None
+        self._xfer_scatter_fn = None
 
     # -- device bodies -----------------------------------------------------
 
@@ -788,6 +790,101 @@ class PagedLlamaDecoder(LlamaDecoder):
                 donate_argnums=(0,),
             )
         return self._copy_fn
+
+    def _gather_blocks_jit(self):
+        """[max_blocks] int32 block ids → per-layer {k, v} GLOBAL
+        arrays [max_blocks, Hkv, bs, hd] (kv heads gathered across tp
+        shards).  One compile: callers pad the id list to
+        ``max_blocks`` with the trash id and slice host-side, so the
+        executable count never grows with prompt length — the
+        disaggregation export primitive (serving/kv_transfer.py)."""
+        if self._xfer_gather_fn is None:
+            def body(pools, bids):
+                return [
+                    {"k": lp["k"][bids], "v": lp["v"][bids]}
+                    for lp in pools
+                ]
+
+            self._xfer_gather_fn = jax.jit(
+                jax.shard_map(
+                    body, mesh=self.mesh,
+                    in_specs=(self._cache_specs, P()),
+                    out_specs=self._cache_specs,
+                    check_vma=False,
+                ),
+            )
+        return self._xfer_gather_fn
+
+    def _scatter_blocks_jit(self):
+        """Per-layer GLOBAL {k, v} arrays [max_blocks, Hkv, bs, hd] +
+        [max_blocks] dst block ids → pools with those rows written.
+        The inverse of ``_gather_blocks_jit``: the input's kv-head dim
+        is split over the model axis by the in_spec, so a payload
+        EXPORTED at one tp width imports at any other — the
+        cross-layout ``model.load`` discipline applied to KV blocks.
+        Padding rows carry the trash id, so their writes are dead by
+        construction (same trick as decode's inactive slots)."""
+        if self._xfer_scatter_fn is None:
+            def body(pools, kv, bids):
+                return [
+                    {
+                        "k": lp["k"].at[bids].set(lkv["k"]),
+                        "v": lp["v"].at[bids].set(lkv["v"]),
+                    }
+                    for lp, lkv in zip(pools, kv)
+                ]
+
+            self._xfer_scatter_fn = jax.jit(
+                jax.shard_map(
+                    body, mesh=self.mesh,
+                    in_specs=(self._cache_specs, self._cache_specs,
+                              P()),
+                    out_specs=self._cache_specs,
+                    check_vma=False,
+                ),
+                donate_argnums=(0,),
+            )
+        return self._xfer_scatter_fn
+
+    def export_blocks(self, block_ids) -> list[dict]:
+        """Read ``block_ids``' K/V out of the pools as host numpy
+        arrays (one {k, v} dict per layer, ``[n, Hkv, bs, hd]`` with
+        the GLOBAL kv-head dim — tp-layout-free)."""
+        bids = np.full((self.max_blocks,), self.trash_id, np.int32)
+        n = len(block_ids)
+        assert n <= self.max_blocks, (n, self.max_blocks)
+        bids[:n] = np.asarray(block_ids, np.int32)
+        gathered = self._gather_blocks_jit()(
+            self.pools, jnp.asarray(bids)
+        )
+        return [
+            {"k": np.asarray(lp["k"][:n]), "v": np.asarray(lp["v"][:n])}
+            for lp in gathered
+        ]
+
+    def import_blocks(self, layers: list[dict], block_ids) -> None:
+        """Write exported K/V rows into THIS decoder's pools at
+        ``block_ids`` (freshly allocated by the caller).  Pads to the
+        one compiled scatter shape; padding rows write to the trash
+        block."""
+        n = len(block_ids)
+        assert n == len(layers[0]["k"]), (n, len(layers[0]["k"]))
+        assert n <= self.max_blocks, (n, self.max_blocks)
+        bids = np.full((self.max_blocks,), self.trash_id, np.int32)
+        bids[:n] = np.asarray(block_ids, np.int32)
+        m = self.model
+        pad_shape = (self.max_blocks, m.n_kv_heads, self.block_size,
+                     self._hd)
+        padded = []
+        for lkv in layers:
+            k = np.zeros(pad_shape, np.asarray(lkv["k"]).dtype)
+            v = np.zeros(pad_shape, np.asarray(lkv["v"]).dtype)
+            k[:n] = lkv["k"]
+            v[:n] = lkv["v"]
+            padded.append({"k": jnp.asarray(k), "v": jnp.asarray(v)})
+        self.pools = self._scatter_blocks_jit()(
+            self.pools, padded, jnp.asarray(bids)
+        )
 
     def bucket_for(self, prompt_len: int) -> int:
         """Servability check (same refusal contract as v1); paged

@@ -60,13 +60,26 @@ from theanompi_tpu.utils.recorder import ServingRecorder
 
 @dataclass
 class Request:
-    """One generation request (all fields host-side)."""
+    """One generation request (all fields host-side).
+
+    ``prefill_only`` / ``handoff`` are the disaggregation fields
+    (serving v4, ``serving/kv_transfer.py``): a prefill-only request
+    runs its prompt to the end of prefill and resolves with
+    ``finish_reason="prefilled"`` carrying the KV handoff record
+    instead of decoding; a request WITH a handoff record skips
+    prefill entirely — its blocks inject and it joins the decode
+    batch directly.  A v1 (non-paged) engine ignores both and serves
+    the full prompt end-to-end, which is token-exact anyway (greedy
+    ids don't depend on where prefill ran) — the router's fallback.
+    """
 
     prompt: list
     max_tokens: int = 16
     temperature: float = 0.0         # <= 0: greedy
     deadline_s: float | None = None  # queue-wait budget from submit
     seed: int = 0                    # per-request PRNG key seed
+    prefill_only: bool = False
+    handoff: dict | None = None
 
 
 @dataclass
@@ -89,6 +102,9 @@ class Result:
     tpot_s: float | None = None   # mean inter-token time after first
     queued_s: float | None = None
     e2e_s: float | None = None
+    # disaggregation: a "prefilled" result carries the KV handoff
+    # record (serving/kv_transfer.py) for the decode-phase dispatch
+    handoff: dict | None = None
 
 
 class ServingFuture:
@@ -328,7 +344,8 @@ class Engine:
         for entry in expired:
             self._shed(entry, "deadline", now)
 
-    def _finish(self, slot: int, reason: str) -> None:
+    def _finish(self, slot: int, reason: str,
+                handoff: dict | None = None) -> None:
         st = self._slots[slot]
         self._slots[slot] = None
         # reset the device-call mirrors: a stale temperature>0 would
@@ -354,6 +371,7 @@ class Engine:
             tokens=list(st.generated),
             ttft_s=ttft, tpot_s=tpot,
             queued_s=None, e2e_s=e2e,
+            handoff=handoff,
         )
         st.entry.future._set(res)
         self.recorder.record_request(
@@ -376,6 +394,66 @@ class Engine:
             self._evictable.evict(n_needed - alloc.blocks_free)
         return alloc.blocks_free >= n_needed
 
+    def _admit_handoff(self, slot: int, entry: _Entry,
+                       now: float) -> bool:
+        """Admit a handed-off request (serving v4): its prompt KV was
+        prefilled on ANOTHER replica; allocate a fresh table, scatter
+        the payload in, and seed the slot directly in the decode
+        state with the prefiller's first token.  Returns False when
+        the pool is dry and someone in flight may free blocks (the
+        entry went back to the queue head — stop admitting).  Any
+        structural failure sheds ``"handoff_failed"`` so the ROUTER
+        can drop the record and requeue the full prompt elsewhere —
+        a handoff is an optimization, never a reason to lose the
+        request."""
+        from theanompi_tpu.serving import kv_transfer
+
+        req = entry.request
+        h = req.handoff
+        ok, why = kv_transfer.compatible(self.decoder, h)
+        if ok and h["n_prompt"] != len(req.prompt):
+            ok, why = False, (
+                f"handoff n_prompt {h['n_prompt']} != prompt "
+                f"length {len(req.prompt)}"
+            )
+        if not ok:
+            print(f"serving: refusing handoff: {why}", flush=True)
+            self._shed(entry, "handoff_failed", now)
+            return True
+        n_blk = h["n_blocks"]
+        plen = len(req.prompt)
+        # reserve what NORMAL admission reserves — blocks_for(plen+1)
+        # covers the first decode write even when the prompt ends on
+        # a block boundary; reserving only the payload's blocks would
+        # let the first grow() hit a dry pool and silently truncate
+        # an "ok" result to one token
+        n_total = max(n_blk, self._mgr.blocks_for(plen + 1))
+        if not self._try_blocks(n_total):
+            if not any(s is not None for s in self._slots):
+                # nothing in flight will ever free a block — let the
+                # router retry the full prompt on a roomier member
+                self._shed(entry, "handoff_failed", now)
+                return True
+            with self._lock:
+                self._queue.appendleft(entry)   # keep FIFO order
+            return False
+        self._mgr.assign(slot, [], n_total)
+        kv_transfer.inject_handoff(self.decoder, self._mgr, slot, h)
+        first = int(h["first_token"])
+        self._slots[slot] = _SlotState(entry, plen, first)
+        self._tokens[slot] = first
+        self._lengths[slot] = plen
+        self._keys[slot] = np.asarray(
+            jax.random.PRNGKey(req.seed), np.uint32
+        )
+        self._temps[slot] = req.temperature
+        self._active[slot] = True
+        if self.eos_id is not None and first == self.eos_id:
+            self._finish(slot, "eos")
+        elif req.max_tokens <= 1:
+            self._finish(slot, "max_tokens")
+        return True
+
     def _admit_paged(self, now: float) -> None:
         for slot in range(self.decoder.max_slots):
             if self._slots[slot] is not None:
@@ -384,6 +462,10 @@ class Engine:
                 entry = self._queue.popleft() if self._queue else None
             if entry is None:
                 return
+            if entry.request.handoff is not None:
+                if not self._admit_handoff(slot, entry, now):
+                    return
+                continue
             req = entry.request
             plen = len(req.prompt)
             # adopt the longest radix-cached prefix (capped so at
@@ -512,6 +594,20 @@ class Engine:
             self._finish(slot, "eos")
         elif req.max_tokens <= 1:
             self._finish(slot, "max_tokens")
+        elif req.prefill_only:
+            # disaggregation: export the prompt's KV blocks + the
+            # first token as a handoff record and finish — the router
+            # carries the record to a decode-specialist replica.  The
+            # radix insert above already happened, so this prefill
+            # still warms THIS replica's cache for the next shared
+            # prefix.  (An eos/max_tokens<=1 request finished
+            # normally above: nothing left to decode, no handoff.)
+            from theanompi_tpu.serving import kv_transfer
+
+            h = kv_transfer.build_handoff(
+                self.decoder, self._mgr, slot, st.prompt_len, first
+            )
+            self._finish(slot, "prefilled", handoff=h)
 
     def _prepare_decode_writes(self) -> None:
         """Before each paged decode step: grow every decoding slot's

@@ -1,0 +1,129 @@
+"""KV-block handoff between replicas — the disaggregated
+prefill/decode substrate (serving v4, DistServe/Splitwise's split).
+
+Chunked prefill (serving v2) already isolates the phase boundary:
+after the last chunk a request's state is exactly (its prompt's KV
+blocks, the first sampled token).  This module turns that state into
+a portable HANDOFF RECORD so a PREFILL-SPECIALIST replica can run the
+compute-bound phase to completion and ship the result to a
+DECODE-SPECIALIST replica, removing prefill chunks from the decode
+replica's engine loop entirely (the chunked-prefill TPOT interference
+the unified engine merely bounds).
+
+The record is host-side data — plain ints plus per-layer K/V numpy
+arrays with the GLOBAL kv-head dim — so it crosses the center-server
+pickle frames unchanged, and the tp layout of either side never
+appears in it: ``PagedLlamaDecoder.export_blocks`` gathers the head
+dim across the sender's shards and ``import_blocks`` re-splits it
+over the receiver's (the cross-layout ``model.load`` discipline
+applied to KV state), so a prompt prefilled at tp=1 decodes at tp=2
+bitwise-identically.
+
+Receive substrate: the decode engine allocates fresh blocks through
+its own ``BlockManager`` (table + refcount machinery — a handed-off
+request is indistinguishable from a locally-prefilled one once
+admitted), scatters the payload in, and seeds the slot directly in
+the ``decode`` state with the prefiller's first token.  Only the
+prompt's ``blocks_for(n_prompt)`` blocks ship; decode-side growth
+allocates the rest as generation crosses block boundaries, exactly
+as it does for local requests.
+
+``compatible`` is the loud refusal gate: geometry (layers, kv heads,
+head_dim, block size, dtype) must match and the receiver's table must
+hold the prompt.  An incompatible or failed handoff never strands the
+request — the router drops the record and requeues the FULL prompt
+through the ordinary failover path (``finish_reason
+"handoff_failed"``), trading the transfer win for availability.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+HANDOFF_VERSION = 1
+
+#: fields every handoff record carries (the wire contract asserted by
+#: ``compatible`` — bump HANDOFF_VERSION when this changes)
+HANDOFF_FIELDS = (
+    "version", "n_prompt", "first_token", "block_size", "n_blocks",
+    "n_layers", "n_kv_heads", "head_dim", "dtype", "layers",
+)
+
+
+def build_handoff(decoder, manager, slot: int, n_prompt: int,
+                  first_token: int) -> dict:
+    """Export ``slot``'s prompt KV (the first ``blocks_for(n_prompt)``
+    table entries) plus the sampled first token as a portable record.
+    Call BEFORE the slot's blocks are freed."""
+    n_blocks = manager.blocks_for(n_prompt)
+    bids = manager.slot_blocks(slot, n_blocks)
+    return {
+        "version": HANDOFF_VERSION,
+        "n_prompt": int(n_prompt),
+        "first_token": int(first_token),
+        "block_size": int(decoder.block_size),
+        "n_blocks": int(n_blocks),
+        "n_layers": int(decoder.model.n_layers),
+        "n_kv_heads": int(decoder.model.n_kv_heads),
+        "head_dim": int(decoder.model.head_dim),
+        "dtype": str(np.dtype(decoder.pools[0]["k"].dtype)),
+        "layers": decoder.export_blocks(bids),
+    }
+
+
+def compatible(decoder, handoff: dict) -> tuple[bool, str]:
+    """Can THIS decoder receive ``handoff``?  Returns ``(ok, why)``
+    — the engine sheds ``"handoff_failed"`` with ``why`` in the log
+    when not, and the router falls back to a full re-prefill."""
+    if not getattr(decoder, "paged", False):
+        return False, "receiver is not a paged decoder"
+    missing = [k for k in HANDOFF_FIELDS if k not in handoff]
+    if missing:
+        return False, f"handoff record missing {missing}"
+    if handoff["version"] != HANDOFF_VERSION:
+        return False, (
+            f"handoff version {handoff['version']} != "
+            f"{HANDOFF_VERSION}"
+        )
+    m = decoder.model
+    geo = {
+        "block_size": decoder.block_size,
+        "n_layers": m.n_layers,
+        "n_kv_heads": m.n_kv_heads,
+        "head_dim": m.head_dim,
+        "dtype": str(np.dtype(decoder.pools[0]["k"].dtype)),
+    }
+    for key, want in geo.items():
+        if handoff[key] != want:
+            return False, (
+                f"handoff {key}={handoff[key]!r} != receiver "
+                f"{want!r}"
+            )
+    if handoff["n_blocks"] > decoder.max_blocks:
+        return False, (
+            f"handoff needs {handoff['n_blocks']} blocks, receiver "
+            f"tables hold {decoder.max_blocks}"
+        )
+    return True, ""
+
+
+def inject_handoff(decoder, manager, slot: int, handoff: dict) -> None:
+    """Receive a handoff into ``slot``: the caller has already
+    reserved the table (``manager.assign(slot, [], n_blocks)``); this
+    scatters the payload into the receiver's pools at the slot's
+    fresh block ids.  After this the slot is exactly what a local
+    prefill of the same prompt would have produced."""
+    n = handoff["n_blocks"]
+    assert manager.n_owned[slot] >= n, (manager.n_owned[slot], n)
+    decoder.import_blocks(
+        handoff["layers"], manager.slot_blocks(slot, n)
+    )
+
+
+def handoff_bytes(handoff: dict) -> int:
+    """Wire size of the record's KV payload (the transfer-cost datum
+    the bench reports alongside the TPOT win)."""
+    return int(sum(
+        np.asarray(lkv["k"]).nbytes + np.asarray(lkv["v"]).nbytes
+        for lkv in handoff["layers"]
+    ))

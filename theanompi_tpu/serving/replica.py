@@ -64,6 +64,8 @@ def result_to_dict(r: Result) -> dict:
         "status": r.status, "finish_reason": r.finish_reason,
         "tokens": list(r.tokens), "ttft_s": r.ttft_s,
         "tpot_s": r.tpot_s, "queued_s": r.queued_s, "e2e_s": r.e2e_s,
+        # numpy KV payloads ride the pickle frames as-is
+        "handoff": r.handoff,
     }
 
 
@@ -80,12 +82,26 @@ class InProcessReplica:
     ``dead=True`` with the cause recorded and its heartbeat stale.
     """
 
+    #: dispatch roles a replica can declare (serving v4): "unified"
+    #: serves end-to-end; "prefill" specialists take prefill-only
+    #: dispatches and ship KV handoffs; "decode" specialists receive
+    #: handoffs and run pure decode.  The ROUTER enforces the policy
+    #: — the engine underneath is identical, which is what makes the
+    #: unified fallback safe.
+    ROLES = ("unified", "prefill", "decode")
+
     def __init__(self, engine: Engine, *, name: str | None = None,
-                 index: int = 0, idle_sleep_s: float = 1e-3):
+                 index: int = 0, idle_sleep_s: float = 1e-3,
+                 role: str = "unified"):
         self.engine = engine
         self.index = int(index)
         self.name = name if name is not None else f"replica{index}"
         self.idle_sleep_s = float(idle_sleep_s)
+        if role not in self.ROLES:
+            raise ValueError(
+                f"role must be one of {self.ROLES}, got {role!r}"
+            )
+        self.role = role
         self._steps = 0
         self._hb = {"progress": 0, "time": 0.0, "status": "starting"}
         self._stop = threading.Event()
@@ -178,6 +194,12 @@ class InProcessReplica:
         scalar."""
         return self.engine.queue_depth() + self.engine.active_slots()
 
+    def slots(self) -> int:
+        """Decode-slot capacity — the autoscaler's denominator when
+        it turns fleet-wide outstanding work into a pressure
+        signal."""
+        return self.engine.decoder.max_slots
+
     def heartbeat(self) -> dict:
         return dict(self._hb)
 
@@ -229,8 +251,9 @@ class ReplicaServer:
 
     def __init__(self, engine: Engine, *, name: str = "replica",
                  index: int = 0, host: str = "127.0.0.1",
-                 port: int = 0):
-        self.replica = InProcessReplica(engine, name=name, index=index)
+                 port: int = 0, role: str = "unified"):
+        self.replica = InProcessReplica(engine, name=name, index=index,
+                                        role=role)
         self._stopped = threading.Event()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -289,6 +312,10 @@ class ReplicaServer:
                             temperature=float(payload["temperature"]),
                             deadline_s=payload.get("deadline_s"),
                             seed=int(payload.get("seed", 0)),
+                            prefill_only=bool(
+                                payload.get("prefill_only", False)
+                            ),
+                            handoff=payload.get("handoff"),
                         )
                         self.replica.submit(req).add_done_callback(
                             lambda r, rid=rid: push(
@@ -301,6 +328,8 @@ class ReplicaServer:
                             "load": self.replica.load(),
                             "alive": self.replica.alive(),
                             "name": self.replica.name,
+                            "role": self.replica.role,
+                            "slots": self.replica.slots(),
                         })))
                     elif cmd == "stats":
                         push(("reply", (payload, {
@@ -350,11 +379,18 @@ class TCPReplicaClient:
                  connect_timeout: float = 120.0,
                  ping_interval_s: float = 0.05,
                  ping_timeout_s: float = 10.0,
-                 send_timeout_s: float = 30.0):
+                 send_timeout_s: float = 30.0,
+                 role: str = "unified", slots: int = 1):
         self.address = tuple(address)
         self.name = name if name is not None else f"tcp:{address[1]}"
         self.send_timeout_s = float(send_timeout_s)
         self.ping_timeout_s = float(ping_timeout_s)
+        # role/slots seed from the caller (who launched the replica
+        # and knows its spec); pongs carrying the server's own values
+        # overwrite them, so a default-constructed client converges
+        # to the truth after one ping round trip
+        self.role = role
+        self._slots = int(slots)
         self.dead = False
         self._rid = itertools.count()
         self._nonce = itertools.count()
@@ -490,6 +526,10 @@ class TCPReplicaClient:
                 return
             self._hb = data["hb"]
             self._load = data["load"]
+            if "role" in data:
+                self.role = data["role"]
+            if "slots" in data:
+                self._slots = int(data["slots"])
             time.sleep(interval)
 
     # -- the replica protocol ----------------------------------------------
@@ -506,6 +546,8 @@ class TCPReplicaClient:
                 "temperature": request.temperature,
                 "deadline_s": request.deadline_s,
                 "seed": request.seed,
+                "prefill_only": request.prefill_only,
+                "handoff": request.handoff,
             }))
         except ConnectionError:
             with self._lock:
@@ -534,6 +576,9 @@ class TCPReplicaClient:
         with self._lock:
             outstanding = len(self._futures)
         return max(self._load, outstanding)
+
+    def slots(self) -> int:
+        return self._slots
 
     def heartbeat(self) -> dict:
         return dict(self._hb)
@@ -579,7 +624,8 @@ def serve_replica_main(argv=None) -> None:
 
     Spec keys: ``config`` (model dict incl. ``tp``), ``checkpoint``
     (dir), ``paged`` (bool), ``decoder`` (decoder kwargs), ``engine``
-    (Engine kwargs), ``name``/``index``, ``host``/``port``.
+    (Engine kwargs), ``name``/``index``, ``host``/``port``,
+    ``role`` (``unified``/``prefill``/``decode`` — serving v4).
     """
     import argparse
     import json
@@ -606,6 +652,7 @@ def serve_replica_main(argv=None) -> None:
         eng, name=spec.get("name", f"replica{index}"), index=index,
         host=spec.get("host", "127.0.0.1"),
         port=int(spec.get("port", 0)),
+        role=spec.get("role", "unified"),
     ).start()
     print(f"REPLICA_READY {srv.address[1]}", flush=True)
     srv.wait()

@@ -51,11 +51,30 @@ bouncing (then ``"failover"``).  Every ``submit()`` future resolves
 with a terminal ``finish_reason``; the engine-level "never hangs"
 guarantee extends to the fleet.
 
+**Roles** (serving v4, ``serving/kv_transfer.py``): members declare
+``"unified"`` / ``"prefill"`` / ``"decode"``.  When a prefill
+specialist AND a decode-capable member are both healthy, a request
+dispatches in two phases — the prompt to a prefiller
+(``prefill_only``), the returned KV handoff record to the owning
+decoder — removing prefill-chunk interference from decode TPOT
+(DistServe/Splitwise's split).  Role purity yields to availability:
+no healthy specialist → unified members serve end-to-end; a failed
+handoff (geometry mismatch, dry pool) drops the record and requeues
+the full prompt.  The fleet TTFT for a disaggregated request is the
+PREFILL side's (the first token exists at handoff time).
+
+**Scaling** (``serving/autoscaler.py``): ``add_replica`` /
+``drain_replica`` / ``remove_replica`` are the control plane's
+membership verbs — a drained member takes no new work and its
+in-flight requests requeue (uncharged) through the same failover
+path, so scale-down never drops a request.
+
 **Observability**: a ``utils.recorder.FleetRecorder`` records every
 terminal result router-side (fleet TTFT/TPOT percentiles survive
-replica death) plus requeue/failover/rejoin counters, and merges
-per-replica ``ServingRecorder`` states for occupancy/hit-rate/rate
-breakdowns (``Router.fleet_summary``).
+replica death) plus requeue/failover/rejoin/handoff counters and the
+spawn/retire event log (replica-seconds — the autoscaler's cost
+metric), and merges per-replica ``ServingRecorder`` states for
+occupancy/hit-rate/rate breakdowns (``Router.fleet_summary``).
 """
 
 from __future__ import annotations
@@ -160,12 +179,18 @@ class ConsistentHashRing:
 
 @dataclass
 class _Member:
-    """One replica's membership record."""
+    """One replica's membership record.  ``role`` drives the
+    disaggregated dispatch (serving v4); ``draining`` marks a
+    scale-down victim — it takes no new work while its in-flight
+    requests are requeued, and ``remove_replica`` retires it."""
 
     replica: object
     name: str
     index: int
+    role: str = "unified"
+    role_pinned: bool = False   # caller-set role: watchdog keeps out
     healthy: bool = True
+    draining: bool = False
     seen_beat: bool = False
     last_hb_time: float = 0.0       # the replica's own stamp clock
     last_beat: float = field(default_factory=time.monotonic)
@@ -175,6 +200,7 @@ class _FleetEntry:
     __slots__ = (
         "rid", "request", "future", "submit_t", "deadline_s",
         "member", "gen", "n_requeues", "affinity_key", "dispatch_t",
+        "handoff", "ttft_prefill", "disagg_ok",
     )
 
     def __init__(self, rid: int, request: Request,
@@ -189,6 +215,12 @@ class _FleetEntry:
         self.n_requeues = 0
         self.affinity_key = affinity_key
         self.dispatch_t: float | None = None
+        # disaggregation: the prefill phase's KV record + honest TTFT
+        # (the first token exists when PREFILL finishes — the decode
+        # replica's own ttft stamp is just its admission time)
+        self.handoff: dict | None = None
+        self.ttft_prefill: float | None = None
+        self.disagg_ok = True   # cleared after a failed handoff
 
 
 class Router:
@@ -242,10 +274,13 @@ class Router:
 
     # -- membership --------------------------------------------------------
 
-    def add_replica(self, replica, name: str | None = None) -> str:
+    def add_replica(self, replica, name: str | None = None,
+                    role: str | None = None) -> str:
         """Register a replica (joins healthy; the watchdog takes it
         from there).  Also the REJOIN path for a replica object the
-        caller relaunched under a new identity."""
+        caller relaunched under a new identity, and the autoscaler's
+        scale-UP hook.  ``role`` defaults to the replica's own
+        ``.role`` attribute ("unified" when absent)."""
         with self._lock:
             name = str(
                 name if name is not None
@@ -253,23 +288,93 @@ class Router:
             )
             if any(m.name == name for m in self._members):
                 raise ValueError(f"duplicate replica name {name!r}")
+            pinned = role is not None
+            role = str(
+                role if pinned
+                else getattr(replica, "role", "unified")
+            )
             self._members.append(
                 _Member(replica=replica, name=name,
-                        index=len(self._members))
+                        index=len(self._members), role=role,
+                        role_pinned=pinned)
             )
             self._ring.add(name)
+            self._pump_locked()   # router-held work may fit NOW
             return name
+
+    def drain_replica(self, name: str) -> int:
+        """Scale-down drain: the member takes NO new dispatches, and
+        its queued + in-flight requests requeue to the rest of the
+        fleet through the ordinary failover/dedup path — first
+        completion wins, late results from the victim are dropped by
+        the generation guard, nothing is lost.  The drain does NOT
+        charge the requests' failover budget (being a scale-down
+        victim is the fleet's choice, not the request's bad luck).
+        Returns how many requests were requeued."""
+        with self._lock:
+            _, n = self._drain_locked(name)
+            self._pump_locked()
+            return n
+
+    def _drain_locked(self, name: str) -> tuple[_Member, int]:
+        """The ONE copy of drain semantics (shared by drain_replica
+        and remove_replica): mark draining, requeue the member's
+        pending work uncharged."""
+        m = self._member_named(name)
+        m.draining = True
+        affected = [
+            e for e in self._pending.values() if e.member is m
+        ]
+        self._requeue_locked(affected, charge=False)
+        return m, len(affected)
+
+    def remove_replica(self, name: str) -> None:
+        """Retire a member (the scale-down endgame, after
+        ``drain_replica``): pull its final recorder snapshot into the
+        fleet recorder — merged telemetry must conserve its request
+        counts after the membership change — then drop it from the
+        member list and the hash ring.  Any stragglers still pinned
+        to it requeue first (uncharged), so calling this without a
+        prior drain is safe too."""
+        with self._lock:
+            m, _ = self._drain_locked(name)
+        try:
+            state = m.replica.recorder_state()
+            paging = m.replica.paging_stats()
+        except Exception:
+            pass      # dead/unreachable: keep the last snapshot
+        else:
+            self.recorder.attach_replica(m.name, state, paging)
+        with self._lock:
+            self._members = [x for x in self._members if x is not m]
+            self._ring.remove(name)
+            self._pump_locked()
+
+    def _member_named(self, name: str) -> _Member:
+        m = next(
+            (m for m in self._members if m.name == str(name)), None
+        )
+        if m is None:
+            raise KeyError(f"no replica named {name!r}")
+        return m
 
     def members(self) -> dict:
         with self._lock:
             return {
                 m.name: {"healthy": m.healthy,
-                         "alive": m.replica.alive()}
+                         "alive": m.replica.alive(),
+                         "role": m.role,
+                         "draining": m.draining}
                 for m in self._members
             }
 
     def _healthy(self) -> list[_Member]:
         return [m for m in self._members if m.healthy]
+
+    def _dispatchable(self) -> list[_Member]:
+        return [
+            m for m in self._members if m.healthy and not m.draining
+        ]
 
     # -- admission (any thread) --------------------------------------------
 
@@ -336,8 +441,36 @@ class Router:
             and m.replica.load() >= self.replica_queue_cap
         )
 
-    def _choose(self, entry: _FleetEntry) -> _Member | None:
-        healthy = self._healthy()
+    def _candidates(
+        self, entry: _FleetEntry
+    ) -> tuple[list[_Member], str]:
+        """Role-aware candidate set + dispatch mode for one entry
+        (serving v4).  Modes: ``"prefill"`` (send the prompt to a
+        prefill specialist, expect a handoff back), ``"decode"``
+        (carry the handoff to a decode-capable member), ``"unified"``
+        (serve end-to-end).  Role purity yields to availability at
+        every step — when no specialist is healthy the request falls
+        back to unified members, and when ONLY specialists are
+        healthy they serve outside their specialty rather than
+        starve the request."""
+        avail = self._dispatchable()
+        if not avail:
+            return [], "unified"
+        pre = [m for m in avail if m.role == "prefill"]
+        dec = [m for m in avail if m.role == "decode"]
+        uni = [m for m in avail if m.role == "unified"]
+        if entry.handoff is not None:
+            return (dec or uni or avail), "decode"
+        if (pre and (dec or uni) and entry.disagg_ok
+                and entry.request.max_tokens > 1):
+            # disaggregate: prefill somewhere that can hand off, and
+            # someone else can decode.  max_tokens<=1 requests have
+            # nothing to decode — a handoff would be pure overhead.
+            return pre, "prefill"
+        return (uni or avail), "unified"
+
+    def _choose(self, entry: _FleetEntry,
+                healthy: list[_Member]) -> _Member | None:
         if not healthy:
             return None
         if self.policy == "prefix_affinity":
@@ -382,7 +515,22 @@ class Router:
             del self._pending[entry.rid]
             self._shed(entry, "deadline")
             return True      # terminal — no longer queued
-        member = self._choose(entry)
+        candidates, mode = self._candidates(entry)
+        member = self._choose(entry, candidates)
+        if member is None and mode != "unified":
+            # role purity yields to availability for LOAD too, not
+            # just health: a saturated/backpressured specialist pool
+            # must not hold a request at the router while non-
+            # specialist members sit idle — a prefill-phase request
+            # serves end-to-end instead, a decode-phase handoff goes
+            # to any member (the engine underneath is identical)
+            rest = [
+                m for m in self._dispatchable()
+                if m not in candidates
+            ]
+            member = self._choose(entry, rest)
+            if member is not None and mode == "prefill":
+                mode = "unified"
         if member is None:
             return False
         entry.gen += 1
@@ -394,6 +542,8 @@ class Router:
             prompt=list(req.prompt), max_tokens=req.max_tokens,
             temperature=req.temperature, deadline_s=remaining,
             seed=req.seed,
+            prefill_only=(mode == "prefill"),
+            handoff=entry.handoff,
         ))
         self.recorder.record_dispatch(member.name)
         efut.add_done_callback(
@@ -409,6 +559,50 @@ class Router:
             entry = self._pending.get(rid)
             if entry is None or entry.gen != gen:
                 return    # stale: requeued elsewhere / double-resolve
+            if (
+                res.status == "ok"
+                and res.finish_reason == "prefilled"
+                and res.handoff is not None
+            ):
+                # phase boundary (serving v4): the prefill specialist
+                # returned the KV record — carry it to a decode
+                # member.  NOT a terminal result: the user future
+                # stays pending and nothing is recorded yet.  The
+                # honest fleet TTFT is the PREFILL side's (the first
+                # token exists now).
+                shift = (
+                    entry.dispatch_t - entry.submit_t
+                    if entry.dispatch_t is not None else 0.0
+                )
+                entry.handoff = res.handoff
+                if res.ttft_s is not None:
+                    entry.ttft_prefill = res.ttft_s + shift
+                entry.gen += 1        # invalidate the prefill hop
+                entry.member = None
+                self.recorder.record_handoff()
+                if self._queue:
+                    # FIFO fairness, same as submit()
+                    self._queue.append(rid)
+                    self._pump_locked()
+                elif not self._try_dispatch(entry):
+                    self._queue.append(rid)
+                return
+            if (
+                res.status == "shed"
+                and entry.handoff is not None
+                and res.finish_reason in ("handoff_failed", "no_blocks")
+            ):
+                # the receiver couldn't take the handoff (geometry
+                # mismatch, dry pool): drop the record and retry the
+                # FULL prompt end-to-end — the transfer is an
+                # optimization, the request must never die with it
+                # (disagg_ok stops the retry from re-disaggregating
+                # into the same failure)
+                entry.handoff = None
+                entry.ttft_prefill = None
+                entry.disagg_ok = False
+                self._requeue_locked([entry])
+                return
             if (
                 res.status == "shed"
                 and res.finish_reason in _REQUEUE_REASONS
@@ -426,12 +620,15 @@ class Router:
             entry.dispatch_t - entry.submit_t
             if entry.dispatch_t is not None else 0.0
         )
+        ttft = (
+            entry.ttft_prefill if entry.ttft_prefill is not None
+            else res.ttft_s + shift if res.ttft_s is not None
+            else None
+        )
         out = Result(
             status=res.status, finish_reason=res.finish_reason,
             tokens=list(res.tokens),
-            ttft_s=(
-                res.ttft_s + shift if res.ttft_s is not None else None
-            ),
+            ttft_s=ttft,
             tpot_s=res.tpot_s,
             queued_s=(
                 res.queued_s + shift
@@ -452,16 +649,21 @@ class Router:
 
     # -- failover ----------------------------------------------------------
 
-    def _requeue_locked(self, entries: list) -> None:
+    def _requeue_locked(self, entries: list, charge: bool = True) -> None:
+        """``charge=False`` (scale-down drains) requeues without
+        spending the entries' failover budget: the fleet chose to
+        move them, so bouncing between drained victims must never
+        shed a request "failover"."""
         n = 0
         for entry in entries:
             entry.gen += 1        # invalidate in-flight callbacks
             entry.member = None
-            if entry.n_requeues >= self.max_requeues:
-                del self._pending[entry.rid]
-                self._shed(entry, "failover")
-                continue
-            entry.n_requeues += 1
+            if charge:
+                if entry.n_requeues >= self.max_requeues:
+                    del self._pending[entry.rid]
+                    self._shed(entry, "failover")
+                    continue
+                entry.n_requeues += 1
             self._queue.append(entry.rid)
             n += 1
         if n:
@@ -503,6 +705,16 @@ class Router:
         for m in members:
             hb = m.replica.heartbeat()
             alive = m.replica.alive()
+            # converge the dispatch role with the replica's own: a
+            # TCP client registered before its first pong reported
+            # the caller's default, and the pong's correction must
+            # reach _candidates(), not just the client object.  A
+            # role the caller EXPLICITLY passed to add_replica is
+            # pinned — the watchdog must not revert that override.
+            role = getattr(m.replica, "role", None)
+            if not m.role_pinned and role is not None \
+                    and role != m.role:
+                m.role = role
             if hb.get("time", 0.0) > m.last_hb_time and alive:
                 m.last_hb_time = hb["time"]
                 m.last_beat = now
@@ -584,6 +796,32 @@ class Router:
     def pending(self) -> int:
         with self._lock:
             return len(self._pending)
+
+    def fleet_capacity(self, default_slots: int = 1) -> int:
+        """Total decode-slot capacity of the dispatchable (healthy,
+        non-draining) members — the autoscaler's pressure
+        denominator.  Replicas without a ``slots()`` probe count as
+        ``default_slots``."""
+        with self._lock:
+            members = self._dispatchable()
+        total = 0
+        for m in members:
+            fn = getattr(m.replica, "slots", None)
+            total += int(fn()) if callable(fn) else int(default_slots)
+        return total
+
+    def member_loads(self) -> dict:
+        """Per-member ``load()`` snapshot of dispatchable members —
+        the autoscaler's victim-selection input."""
+        with self._lock:
+            members = self._dispatchable()
+        return {m.name: m.replica.load() for m in members}
+
+    def replica_named(self, name: str):
+        """The replica object behind a member (the autoscaler's
+        retire hook needs it after ``remove_replica`` forgets it)."""
+        with self._lock:
+            return self._member_named(name).replica
 
     def refresh_replica_stats(self) -> None:
         """Pull each reachable replica's recorder state (and paging

@@ -35,6 +35,16 @@ with actions
   continue at the new dp by resharding its checkpoint
   (docs/RESILIENCE.md elasticity).  Fires once, persisted across
   relaunches like every other action.
+- ``spike_load`` — the AUTOSCALER drill (``serving/autoscaler.py``):
+  raise :class:`LoadSpike` out of the autoscaler's policy-loop tick.
+  The autoscaler treats the spike as a sustained-backpressure
+  certificate and scales up IMMEDIATELY (hysteresis bypassed), so
+  the fault matrix can force a fleet through a scale-up — and, with
+  a ``die_replica`` aimed at a prefill specialist in the same
+  ``TM_FAULT_AT`` list, kill that specialist mid-handoff while the
+  spike's traffic is in flight — without shaping real traffic.  For
+  this action the ``<epoch>`` field is the AUTOSCALER's index
+  (``Autoscaler(index=...)``) and ``<iter>`` its tick count.
 
 A fault fires at most ONCE.  Under a supervisor the relaunched
 process would otherwise re-read the same env and re-die at the same
@@ -61,7 +71,7 @@ _STATE_ENV = "TM_FAULT_STATE"
 
 ACTIONS = (
     "die", "hang", "sigterm", "corrupt_ckpt", "die_replica",
-    "lose_device", "shrink_world",
+    "lose_device", "shrink_world", "spike_load",
 )
 
 
@@ -70,6 +80,13 @@ class ReplicaDied(RuntimeError):
     loop (a serving replica's owner loop), not the whole process —
     the replica reads as dead fleet-side (stale heartbeat /
     ``alive=False``) while its host process stays inspectable."""
+
+
+class LoadSpike(RuntimeError):
+    """Raised by the ``spike_load`` fault action out of the
+    autoscaler's policy tick: the autoscaler catches it and scales up
+    immediately, as if backpressure had been sustained past the
+    hysteresis window — the deterministic scale-up drill."""
 
 #: parsed fault list — ``"unset"`` sentinel until first read, then
 #: ``None`` (no faults) or a list of ``(epoch, iter, action)``
@@ -116,8 +133,8 @@ def _target() -> list[tuple[int, int, str]] | None:
                 raise ValueError(
                     f"{_ENV} must be "
                     f"'<epoch>:<iter>[:die|hang|sigterm|corrupt_ckpt"
-                    f"|die_replica|lose_device|shrink_world][,...]', "
-                    f"got {raw!r}"
+                    f"|die_replica|lose_device|shrink_world"
+                    f"|spike_load][,...]', got {raw!r}"
                 ) from err
             if not _parsed:
                 _parsed = None
@@ -250,6 +267,11 @@ def _execute(action: str, epoch: int, it: int,
         raise ReplicaDied(
             f"{_ENV}: die_replica fired at replica {epoch} "
             f"iteration {it}"
+        )
+    if action == "spike_load":
+        raise LoadSpike(
+            f"{_ENV}: spike_load fired at autoscaler {epoch} "
+            f"tick {it}"
         )
     if action == "sigterm":
         # planned preemption: the worker's graceful handler (installed

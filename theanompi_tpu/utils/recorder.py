@@ -532,7 +532,13 @@ class FleetRecorder:
         self.n_requeues = 0
         self.n_failovers = 0
         self.n_rejoins = 0
+        self.n_handoffs = 0
         self.dispatched = Counter()
+        # autoscaler event log (serving v4): one entry per membership
+        # change, the ground truth replica-seconds accounting is
+        # computed from.  Spawn/retire pair up per replica name;
+        # multiple lives (retire then re-spawn) stack.
+        self.scale_events: list[dict] = []
 
     # -- router-side events ------------------------------------------------
 
@@ -550,6 +556,51 @@ class FleetRecorder:
 
     def record_rejoin(self, replica: str) -> None:
         self.n_rejoins += 1
+
+    def record_handoff(self, n: int = 1) -> None:
+        """One prefill→decode KV handoff carried router-side."""
+        self.n_handoffs += int(n)
+
+    # -- autoscaler events (replica-seconds accounting) --------------------
+
+    def record_spawn(self, replica: str, t: float | None = None,
+                     reason: str = "") -> None:
+        """A replica entered the serving fleet (scale-up, or the
+        initially provisioned members at fleet start)."""
+        self.scale_events.append({
+            "event": "spawn", "replica": str(replica),
+            "t": float(t if t is not None else time.monotonic()),
+            "reason": str(reason),
+        })
+
+    def record_retire(self, replica: str, t: float | None = None,
+                      reason: str = "") -> None:
+        """A replica left the fleet (drained scale-down)."""
+        self.scale_events.append({
+            "event": "retire", "replica": str(replica),
+            "t": float(t if t is not None else time.monotonic()),
+            "reason": str(reason),
+        })
+
+    def replica_seconds(self, now: float | None = None) -> float:
+        """Integrated capacity cost: Σ over fleet lives of
+        (retire_t − spawn_t), open lives closing at ``now``.  THE
+        autoscaler headline denominator — the diurnal bench's claim
+        is SLOs held at fewer replica-seconds than a statically
+        provisioned fleet, and this is where that number comes
+        from."""
+        now = float(now if now is not None else time.monotonic())
+        open_lives: dict[str, list[float]] = {}
+        total = 0.0
+        for ev in self.scale_events:
+            name = ev["replica"]
+            if ev["event"] == "spawn":
+                open_lives.setdefault(name, []).append(ev["t"])
+            elif open_lives.get(name):
+                total += max(0.0, ev["t"] - open_lives[name].pop())
+        for starts in open_lives.values():
+            total += sum(max(0.0, now - t) for t in starts)
+        return total
 
     # -- replica summaries -------------------------------------------------
 
@@ -574,7 +625,17 @@ class FleetRecorder:
             n_requeues=self.n_requeues,
             n_failovers=self.n_failovers,
             n_rejoins=self.n_rejoins,
+            n_handoffs=self.n_handoffs,
             dispatched=dict(self.dispatched),
+            n_spawns=sum(
+                e["event"] == "spawn" for e in self.scale_events
+            ),
+            n_retires=sum(
+                e["event"] == "retire" for e in self.scale_events
+            ),
+            replica_seconds=(
+                self.replica_seconds() if self.scale_events else None
+            ),
         )
         per, merged = {}, ServingRecorder(max_slots=0)
         for name, state in self.replica_states.items():

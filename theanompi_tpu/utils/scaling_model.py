@@ -192,6 +192,7 @@ def bsp_efficiency(
     chip: ChipSpec = V5E,
     overlap_frac: float = 2.0 / 3.0,
     compression: str | None = None,
+    bw: float | None = None,
 ) -> dict:
     """Predicted BSP scaling efficiency at ``n_chips`` (per-chip batch
     held constant — the reference's weak-scaling regime, SURVEY §6).
@@ -207,6 +208,11 @@ def bsp_efficiency(
     ``compression`` (``int8``/``fp8``): the quantized wire — 1 byte
     per gradient element + per-chunk scales (supersedes
     ``wire_dtype_bytes``; ``exchange_wire_bytes``).
+    ``bw``: per-chip exchange bandwidth override (bytes/s) — the
+    MEASURED-anchor path (tests/test_scaling_model.py validates the
+    predictor against ``trace_comm``-measured localhost BSP runs by
+    calibrating this from one world size and predicting another),
+    and the DCN case where the ring crosses host NICs.
     """
     if compression in ("int8", "fp8"):
         wire_bytes = exchange_wire_bytes(
@@ -214,7 +220,7 @@ def bsp_efficiency(
         )
     else:
         wire_bytes = param_bytes * wire_dtype_bytes / 4.0
-    t_ar = allreduce_time(wire_bytes, n_chips, chip)
+    t_ar = allreduce_time(wire_bytes, n_chips, chip, bw=bw)
     exposed = max(0.0, t_ar - overlap_frac * step_time_1chip)
     eff_overlap = step_time_1chip / (step_time_1chip + exposed)
     eff_serial = step_time_1chip / (step_time_1chip + t_ar)
