@@ -1,9 +1,11 @@
 #!/usr/bin/env python
 """Lint gate for scripts/tier1.sh (ISSUE 4 satellite).
 
-Prefers a real linter when the environment has one (``ruff check``,
-then ``pyflakes``); otherwise falls back to the bundled minimal
-checker so the gate is never silently skipped:
+Two stages, both mandatory:
+
+**Generic lint.**  Prefers a real linter when the environment has
+one (``ruff check``, then ``pyflakes``); otherwise falls back to the
+bundled minimal checker so the gate is never silently skipped:
 
 - every file must parse (``ast.parse`` — a stronger version of the
   ``compileall`` syntax gate, with real line numbers);
@@ -14,6 +16,13 @@ checker so the gate is never silently skipped:
   strings, docstring references and string-typed annotations all
   count), ``__init__.py`` re-export files are skipped, and a
   ``# noqa`` on the import line opts out.
+
+**tmcheck** (ISSUE 12): the project-native static-analysis suite —
+lock discipline, ABBA lock-order, held-lock side effects, JAX
+hot-path sanitizer (``python -m theanompi_tpu.analysis``; catalog in
+docs/ANALYSIS.md).  Runs REGARDLESS of which generic linter ran —
+ruff knows nothing about our lock registry.  ``--changed-only``
+passes the fast mode through (files changed vs HEAD).
 
 Exit 0 = clean, 1 = findings, 2 = could not run.
 """
@@ -101,7 +110,7 @@ def _check_file(path: Path) -> list[str]:
     return findings
 
 
-def main() -> int:
+def _generic_lint() -> int:
     rc = _external_linter()
     if rc is not None:
         return rc
@@ -118,6 +127,31 @@ def main() -> int:
     if findings:
         print(f"lint_gate: {len(findings)} finding(s)", file=sys.stderr)
     return 1 if findings else 0
+
+
+def _tmcheck(changed_only: bool) -> int:
+    """The project-native suite as a subprocess: its jax import must
+    not slow the generic stage, and a crash is exit 2, not a
+    traceback through the gate."""
+    cmd = [sys.executable, "-m", "theanompi_tpu.analysis"]
+    if changed_only:
+        cmd.append("--changed-only")
+    try:
+        return subprocess.call(cmd, cwd=REPO)
+    except OSError:
+        return 2
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    changed_only = "--changed-only" in argv
+    rc_lint = _generic_lint()
+    rc_tm = _tmcheck(changed_only)
+    if rc_tm != 0:
+        print("lint_gate: tmcheck stage failed "
+              "(see findings above; docs/ANALYSIS.md has the "
+              "catalog)", file=sys.stderr)
+    return max(rc_lint, rc_tm)
 
 
 if __name__ == "__main__":

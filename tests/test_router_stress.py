@@ -1,0 +1,242 @@
+"""Slow-tier concurrency stress: the DYNAMIC witness for tmcheck's
+static rule families 1–3 (ISSUE 12).
+
+The static suite proves the router's lock discipline lexically; this
+test hammers the same invariants at runtime: `Router.submit` from
+many threads racing membership churn (`add_replica` /
+`drain_replica` / `remove_replica`) and watchdog health passes, over
+scripted auto-resolving replicas.  The contract under stress:
+
+- EVERY submitted future resolves with a terminal result (the fleet
+  "never hangs" guarantee survives churn);
+- dispatch/telemetry counters conserve: the router records exactly
+  one terminal per admitted request — ok + shed == submitted — and
+  requeues are bounded by the failover budget;
+- no deadlock: the whole drill completes inside its deadline (an
+  ABBA inversion between router/replica locks would hang it).
+"""
+
+import threading
+import time
+
+import pytest
+
+from theanompi_tpu.serving import Router
+from theanompi_tpu.serving.engine import Request, Result, ServingFuture
+
+pytestmark = [pytest.mark.serving, pytest.mark.slow]
+
+
+class AutoReplica:
+    """Scripted replica that resolves every submit from its own
+    worker thread after a tiny service time — enough concurrency to
+    race the router's dispatch/requeue/drain paths for real."""
+
+    def __init__(self, name, slots=4, service_s=0.0005):
+        self.name = name
+        self.role = "unified"
+        self._slots = int(slots)
+        self.service_s = float(service_s)
+        self._hb = {"progress": 0, "time": time.time(),
+                    "status": "running"}
+        self._lock = threading.Lock()
+        self._inbox = []
+        self._alive = True
+        self.n_served = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._serve, name=f"stress-{name}", daemon=True
+        )
+        self._thread.start()
+        self._beater = threading.Thread(
+            target=self._beat, name=f"stress-{name}-hb", daemon=True
+        )
+        self._beater.start()
+
+    def _beat(self):
+        while not self._stop.is_set():
+            self._hb = {
+                "progress": self._hb["progress"] + 1,
+                "time": time.time(), "status": "running",
+            }
+            time.sleep(0.002)
+
+    def _serve(self):
+        while not self._stop.is_set():
+            with self._lock:
+                batch, self._inbox = self._inbox, []
+            if not batch:
+                time.sleep(0.0005)
+                continue
+            time.sleep(self.service_s)
+            for req, fut in batch:
+                n = min(req.max_tokens, 2)
+                fut._set(Result(
+                    status="ok", finish_reason="max_tokens",
+                    tokens=list(range(n)), ttft_s=0.001,
+                    tpot_s=0.0005, queued_s=0.0, e2e_s=0.002,
+                ))
+                self.n_served += 1
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=10.0)
+        self._beater.join(timeout=10.0)
+        # a retired replica must not strand accepted work: shed it
+        # (the router's generation guard drops these as stale if it
+        # already requeued them elsewhere — first completion wins)
+        with self._lock:
+            batch, self._inbox = self._inbox, []
+        for _, fut in batch:
+            fut._set(Result(status="shed", finish_reason="shutdown"))
+
+    # -- the replica protocol ----------------------------------------
+
+    def submit(self, request: Request) -> ServingFuture:
+        fut = ServingFuture()
+        with self._lock:
+            self._inbox.append((request, fut))
+        return fut
+
+    def load(self) -> int:
+        with self._lock:
+            return len(self._inbox)
+
+    def slots(self) -> int:
+        return self._slots
+
+    def heartbeat(self) -> dict:
+        return dict(self._hb)
+
+    def alive(self) -> bool:
+        return self._alive and not self._stop.is_set()
+
+    def recorder_state(self) -> dict:
+        from theanompi_tpu.utils.recorder import ServingRecorder
+
+        return ServingRecorder(max_slots=self._slots).state_dict()
+
+    def paging_stats(self):
+        return None
+
+
+def test_submit_vs_membership_churn_conserves_every_future():
+    N_SUBMITTERS = 6
+    N_PER_THREAD = 60
+    N_CHURN_ROUNDS = 25
+
+    replicas = [AutoReplica(f"s{i}") for i in range(3)]
+    router = Router(
+        replicas,
+        policy="least_loaded",
+        fleet_queue_cap=10_000,
+        default_deadline_s=60.0,
+        replica_queue_cap=None,
+        startup_grace_s=60.0,
+        health_interval_s=0.002,
+        max_requeues=8,
+    ).start()
+
+    futures: list[ServingFuture] = []
+    fut_lock = threading.Lock()
+    spawned: list[AutoReplica] = []
+    errors: list[BaseException] = []
+
+    def submitter(tid):
+        try:
+            for i in range(N_PER_THREAD):
+                f = router.submit(
+                    [1 + tid, 2 + i % 7, 3], max_tokens=2
+                )
+                with fut_lock:
+                    futures.append(f)
+                if i % 16 == 0:
+                    time.sleep(0.001)
+        except BaseException as e:   # noqa: BLE001 - surfaced below
+            errors.append(e)
+
+    def churner():
+        try:
+            for round_ in range(N_CHURN_ROUNDS):
+                r = AutoReplica(f"churn{round_}")
+                spawned.append(r)
+                name = router.add_replica(r)
+                time.sleep(0.004)
+                # drain + retire through the scale-down path: its
+                # in-flight work requeues UNCHARGED to the others
+                router.drain_replica(name)
+                router.remove_replica(name)
+                r.stop()
+        except BaseException as e:   # noqa: BLE001
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=submitter, args=(t,), daemon=True)
+        for t in range(N_SUBMITTERS)
+    ] + [threading.Thread(target=churner, daemon=True)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120.0)
+    assert not any(t.is_alive() for t in threads), "drill wedged"
+    assert not errors, errors
+
+    assert router.drain(timeout=60.0), (
+        f"{router.pending()} requests never resolved"
+    )
+
+    n_submitted = N_SUBMITTERS * N_PER_THREAD
+    assert len(futures) == n_submitted
+    # EVERY future resolved, each with a terminal reason
+    results = [f.result(timeout=5.0) for f in futures]
+    assert all(r.status in ("ok", "shed") for r in results)
+    n_ok = sum(r.status == "ok" for r in results)
+    n_shed = n_submitted - n_ok
+    # with an uncharged drain path and a generous failover budget,
+    # churn must not eat requests: sheds can only be the rare
+    # failover-budget exhaustion, never a silent loss
+    assert n_ok >= n_submitted * 0.95, (n_ok, n_shed)
+
+    # conservation: the fleet recorder saw exactly one terminal per
+    # admitted request (the router records router-side, so the
+    # counts survive every membership change)
+    router.stop(drain_s=5.0)
+    summary = router.recorder.summary()
+    assert summary["n_requests"] == n_submitted
+    assert summary["n_completed"] == n_ok
+    assert summary["n_shed"] == n_shed
+    # the permanent members' service counts cover the ok results not
+    # served by churn victims; nothing disappeared into a drained
+    # member (first-completion-wins may double-serve, never lose)
+    assert sum(r.n_served for r in replicas + spawned) >= n_ok
+
+    for r in replicas:
+        r.stop()
+
+
+def test_churn_only_fleet_still_terminal():
+    """Pathological arm: every dispatch races a drain — futures must
+    still all resolve (possibly shed 'failover'), never hang."""
+    base = AutoReplica("base", service_s=0.002)
+    router = Router(
+        [base], policy="round_robin",
+        replica_queue_cap=None, startup_grace_s=60.0,
+        health_interval_s=0.002, default_deadline_s=20.0,
+        max_requeues=2,
+    ).start()
+
+    futures = [router.submit([1, 2, 3], max_tokens=2)
+               for _ in range(40)]
+    victim = AutoReplica("victim", service_s=0.01)
+    name = router.add_replica(victim)
+    router.drain_replica(name)
+    futures += [router.submit([4, 5, 6], max_tokens=2)
+                for _ in range(40)]
+    router.remove_replica(name)
+    victim.stop()
+
+    assert router.drain(timeout=30.0)
+    results = [f.result(timeout=5.0) for f in futures]
+    assert all(r.status in ("ok", "shed") for r in results)
+    router.stop(drain_s=5.0)
+    base.stop()

@@ -1,0 +1,176 @@
+"""tmcheck core + CLI (theanompi_tpu/analysis/{core,cli}.py):
+suppression semantics and stale-suppression tracking (TM201), the
+full-tree dogfood invariant (zero unsuppressed findings — the state
+the lint gate enforces), CLI exit codes, and deterministic output.
+"""
+
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+from theanompi_tpu.analysis import core, hotpath, locks
+from theanompi_tpu.analysis.cli import DEFAULT_TARGETS, run_suite
+
+ROOT = Path(__file__).resolve().parent.parent
+
+
+def run(src: str) -> list:
+    sf = core.SourceFile(textwrap.dedent(src), "fixture.py")
+    return core.collect(
+        [sf],
+        rule_fns=(locks.check_file, hotpath.check_file),
+        cross_fns=(locks.check_lock_order,),
+    )
+
+
+class TestSuppressions:
+    BAD = """
+        import threading
+
+        class MiniRouter:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def dispatch(self, fut):
+                with self._lock:
+                    fut.add_done_callback(print){suffix}
+    """
+
+    def test_finding_without_suppression(self):
+        out = run(self.BAD.format(suffix=""))
+        assert [f.rule for f in out] == ["TM103"]
+
+    def test_suppression_silences(self):
+        out = run(self.BAD.format(suffix="  # tmcheck: disable=TM103"))
+        assert out == []
+
+    def test_wrong_rule_suppression_does_not_silence(self):
+        out = run(self.BAD.format(suffix="  # tmcheck: disable=TM104"))
+        assert sorted(f.rule for f in out) == ["TM103", "TM201"]
+
+    def test_stale_suppression_flagged(self):
+        out = run("""
+            def helper():
+                return 1  # tmcheck: disable=TM103
+        """)
+        assert [f.rule for f in out] == ["TM201"]
+
+    def test_unknown_rule_id_flagged(self):
+        out = run("""
+            def helper():
+                return 1  # tmcheck: disable=TM999
+        """)
+        assert [f.rule for f in out] == ["TM201"]
+        assert "unknown rule id" in out[0].message
+
+    def test_docstring_mention_is_not_an_annotation(self):
+        # only REAL comments (tokenize) activate tmcheck markers — a
+        # docstring quoting the syntax must not create suppressions
+        out = run('''
+            def helper():
+                """Write `# tmcheck: disable=TM103` on the line."""
+                return 1
+        ''')
+        assert out == []
+
+    def test_partial_run_exempts_cross_file_suppressions(self):
+        # changed-only mode analyzes a subset: a TM102 suppression's
+        # finding may ride a lock-graph edge in an UNCHANGED file, so
+        # it is not stale there — but it IS in a full run
+        src = textwrap.dedent("""
+            def helper():
+                return 1  # tmcheck: disable=TM102
+        """)
+        full = core.collect(
+            [core.SourceFile(src, "fixture.py")],
+            rule_fns=(locks.check_file,),
+        )
+        assert [f.rule for f in full] == ["TM201"]
+        part = core.collect(
+            [core.SourceFile(src, "fixture.py")],
+            rule_fns=(locks.check_file,), partial=True,
+        )
+        assert part == []
+
+    def test_multiple_rules_one_comment(self):
+        out = run("""
+            import threading
+            import time
+
+            class Loop:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def nap(self):
+                    with self._lock:
+                        time.sleep(0.1)  # tmcheck: disable=TM103, TM104
+        """)
+        # TM103 matched; TM104 on the same line is stale
+        assert [f.rule for f in out] == ["TM201"]
+
+
+class TestTreeIsClean:
+    def test_zero_unsuppressed_findings_over_the_tree(self):
+        """THE dogfood invariant (ISSUE 12 acceptance): the full
+        suite over theanompi_tpu/ + tests/ reports nothing.  A
+        finding here means either a real concurrency/hot-path bug
+        landed, or a deliberate pattern needs its documented
+        suppression."""
+        findings = run_suite(ROOT, DEFAULT_TARGETS)
+        assert findings == [], "\n".join(str(f) for f in findings)
+
+    def test_deterministic(self):
+        a = run_suite(ROOT, ["theanompi_tpu/serving"])
+        b = run_suite(ROOT, ["theanompi_tpu/serving"])
+        assert a == b == []
+
+
+class TestCLI:
+    def _run(self, *args):
+        return subprocess.run(
+            [sys.executable, "-m", "theanompi_tpu.analysis", *args],
+            cwd=ROOT, capture_output=True, text=True, timeout=300,
+            env={"JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin",
+                 "HOME": "/tmp"},
+        )
+
+    def test_clean_tree_exits_zero(self):
+        r = self._run("theanompi_tpu/serving")
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_findings_exit_one(self, tmp_path):
+        bad = tmp_path / "bad_fixture.py"
+        bad.write_text(textwrap.dedent("""
+            import threading
+
+            class MiniRouter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def dispatch(self, fut):
+                    with self._lock:
+                        fut.add_done_callback(print)
+        """))
+        r = self._run(str(bad))
+        assert r.returncode == 1
+        assert "TM103" in r.stdout
+        assert "finding(s)" in r.stderr
+
+    def test_list_rules(self):
+        r = self._run("--list-rules")
+        assert r.returncode == 0
+        for rule in core.RULES:
+            assert rule in r.stdout
+
+    def test_changed_only_runs(self):
+        # smoke: must exit 0 or 1 quickly regardless of git state
+        r = self._run("--changed-only")
+        assert r.returncode in (0, 1), r.stdout + r.stderr
+
+    def test_rule_catalog_documented(self):
+        """Every rule id appears in docs/ANALYSIS.md (the catalog
+        can't silently drift from the implementation)."""
+        doc = (ROOT / "docs" / "ANALYSIS.md").read_text()
+        for rule in core.RULES:
+            assert rule in doc, f"{rule} missing from docs/ANALYSIS.md"

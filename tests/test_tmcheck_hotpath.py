@@ -1,0 +1,240 @@
+"""tmcheck hot-path sanitizer (theanompi_tpu/analysis/hotpath.py):
+TM104 host-sync fences, TM105 value-dependent shapes, TM106
+trace-time wall-clock/RNG.  The headline regression fixture is the
+PR 6 per-chunk ``int()`` fence in chunked prefill (the bug
+docs/PERFORMANCE.md's "no per-step value fences" lever retired) —
+re-introducing it must be caught, while the post-fix shape (ONE
+fence after the loop) stays clean.
+"""
+
+import textwrap
+
+from theanompi_tpu.analysis import core, hotpath
+
+
+def run(src: str) -> list:
+    sf = core.SourceFile(textwrap.dedent(src), "fixture.py")
+    return core.collect([sf], rule_fns=(hotpath.check_file,))
+
+
+def rules_of(findings) -> list:
+    return [f.rule for f in findings]
+
+
+class TestHostFences:
+    def test_pr6_per_chunk_int_fence_flagged(self):
+        # the PR 6 regression: chunked prefill reading each chunk's
+        # token back to host inside the chunk loop
+        out = run("""
+            class Dec:
+                def prefill(self, ids, key):
+                    pos = 0
+                    tok = None
+                    while pos < len(ids):
+                        out = self._prefill_jit(True)(ids[pos:pos + 8], key)
+                        tok = int(out)
+                        pos += 8
+                    return tok
+        """)
+        assert rules_of(out) == ["TM104"]
+        assert "per-iteration int() fence" in out[0].message
+
+    def test_one_fence_after_loop_clean(self):
+        # the post-fix discipline: dispatch stays async, ONE sync at
+        # the end (decoder.prefill's documented TTFT fence)
+        out = run("""
+            class Dec:
+                def prefill(self, ids, key):
+                    pos = 0
+                    out = None
+                    while pos < len(ids):
+                        out = self._prefill_jit(True)(ids[pos:pos + 8], key)
+                        pos += 8
+                    return int(out)
+        """)
+        assert out == []
+
+    def test_untainted_int_in_loop_clean(self):
+        # host bookkeeping ints are not fences
+        out = run("""
+            class Eng:
+                def step(self, slots):
+                    n = 0
+                    for s in slots:
+                        n += int(s.budget)
+                    return n
+        """)
+        assert out == []
+
+    def test_item_and_block_until_ready_flagged_anywhere(self):
+        out = run("""
+            import jax
+            import jax.numpy as jnp
+
+            class Dec:
+                def decode(self, x):
+                    y = jnp.exp(x)
+                    jax.block_until_ready(y)
+                    return y.item()
+        """)
+        assert rules_of(out) == ["TM104", "TM104"]
+
+    def test_np_asarray_of_device_value_in_loop_flagged(self):
+        out = run("""
+            import numpy as np
+
+            class Dec:
+                def decode_step(self, chunks):
+                    outs = []
+                    for c in chunks:
+                        y = self._decode_jit(True)(c)
+                        outs.append(np.asarray(y))
+                    return outs
+        """)
+        assert rules_of(out) == ["TM104"]
+
+    def test_non_hot_function_exempt(self):
+        out = run("""
+            class Dec:
+                def gather(self, chunks):
+                    outs = []
+                    for c in chunks:
+                        y = self._gather_jit(True)(c)
+                        outs.append(int(y))
+                    return outs
+        """)
+        assert out == []
+
+    def test_hot_marker_opts_in(self):
+        out = run("""
+            class Dec:
+                def gather(self, chunks):  # tmcheck: hot
+                    outs = []
+                    for c in chunks:
+                        y = self._gather_jit(True)(c)
+                        outs.append(int(y))
+                    return outs
+        """)
+        assert rules_of(out) == ["TM104"]
+
+    def test_test_functions_exempt(self):
+        out = run("""
+            def test_decode_roundtrip(dec, chunks):
+                for c in chunks:
+                    assert int(dec_jit(c)) >= 0
+        """)
+        assert out == []
+
+
+class TestShapes:
+    def test_fence_derived_shape_flagged(self):
+        out = run("""
+            import jax.numpy as jnp
+
+            class Dec:
+                def decode_step(self, lengths):
+                    n = int(jnp.max(lengths))
+                    return jnp.zeros((n, 4))
+        """)
+        assert rules_of(out) == ["TM105"]
+        assert "one-compile" in out[0].message
+
+    def test_bucketed_shape_clean(self):
+        out = run("""
+            import jax.numpy as jnp
+
+            class Dec:
+                def decode_step(self, prompt):
+                    n = self.bucket_for(len(prompt))
+                    return jnp.zeros((n, 4))
+        """)
+        assert out == []
+
+
+class TestTracedBodies:
+    def test_wall_clock_in_jitted_body_flagged(self):
+        out = run("""
+            import time
+            import jax
+
+            class Dec:
+                def _decode_body(self, params, x):
+                    t = time.time()
+                    return x * t
+
+                def build(self):
+                    return jax.jit(self._decode_body)
+        """)
+        assert rules_of(out) == ["TM106"]
+        assert "TRACE time" in out[0].message
+
+    def test_host_rng_in_scan_body_flagged(self):
+        out = run("""
+            import numpy as np
+            from jax import lax
+
+            def build(xs):
+                def step(carry, x):
+                    noise = np.random.randn()
+                    return carry + x + noise, x
+                return lax.scan(step, 0.0, xs)
+        """)
+        assert rules_of(out) == ["TM106"]
+        assert "jax.random" in out[0].message
+
+    def test_item_in_traced_body_flagged(self):
+        out = run("""
+            import jax
+
+            @jax.jit
+            def decode_step(x):
+                return x.item()
+        """)
+        assert rules_of(out) == ["TM104"]
+        assert "tracer" in out[0].message
+
+    def test_wall_clock_in_host_loop_clean(self):
+        # engine.step stamps wall time between dispatches — host
+        # code, perfectly legal
+        out = run("""
+            import time
+
+            class Eng:
+                def step(self):
+                    t0 = time.monotonic()
+                    self._decode_once()
+                    return time.monotonic() - t0
+        """)
+        assert out == []
+
+    def test_nested_def_inside_traced_body_is_traced(self):
+        out = run("""
+            import time
+            import jax
+
+            def build():
+                def outer(x):
+                    def inner(y):
+                        return y * time.time()
+                    return inner(x)
+                return jax.jit(outer)
+        """)
+        assert rules_of(out) == ["TM106"]
+
+
+class TestSuppressionTracking:
+    def test_suppressed_fence_and_stale_marker(self):
+        out = run("""
+            class Dec:
+                def prefill(self, ids):
+                    toks = []
+                    for c in ids:
+                        y = self._prefill_jit(True)(c)
+                        toks.append(int(y))  # tmcheck: disable=TM104
+                    n = len(toks)  # tmcheck: disable=TM104
+                    return toks
+        """)
+        # the loop fence is suppressed; the second marker sits on a
+        # clean line and is itself flagged as stale
+        assert rules_of(out) == ["TM201"]
+        assert "matches no finding" in out[0].message

@@ -1,0 +1,29 @@
+"""tmcheck — the project-native static-analysis suite.
+
+AST/CFG-lite checkers for the bug classes every threaded-control-
+plane PR has re-shipped (see docs/ANALYSIS.md for the catalog and
+ISSUE 12 for the lineage):
+
+- ``locks.py`` — TM101 lock discipline, TM102 ABBA/lock-order
+  cycles, TM103 held-lock side effects.
+- ``hotpath.py`` — TM104/TM105/TM106, the JAX hot-path sanitizer.
+- ``refusals.py`` — the generated ``docs/REFUSALS.md``
+  NotImplementedError matrix.
+- ``core.py`` — findings, ``# tmcheck:`` annotations, suppression
+  tracking (TM201 stale-suppression).
+
+Run it: ``python -m theanompi_tpu.analysis`` or the ``tmcheck``
+entry point; ``scripts/lint_gate.py`` runs it as a tier-1 stage.
+"""
+
+from theanompi_tpu.analysis.core import (
+    RULES,
+    Finding,
+    SourceFile,
+    collect,
+    iter_source_files,
+)
+
+__all__ = [
+    "RULES", "Finding", "SourceFile", "collect", "iter_source_files",
+]

@@ -1,0 +1,8 @@
+"""``python -m theanompi_tpu.analysis`` — see ``cli.py``."""
+
+import sys
+
+from theanompi_tpu.analysis.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,144 @@
+"""Generated refusal matrix: every ``raise NotImplementedError`` in
+the tree, inventoried into ``docs/REFUSALS.md``.
+
+ROADMAP item 4 ("close the NotImplementedError matrix") needs an
+accurate list to close against; a hand-maintained table drifts the
+first time a refusal is added or removed.  This pass makes the
+matrix machine-maintained: ``python -m theanompi_tpu.analysis
+--write-refusals`` regenerates the doc, and
+``tests/test_refusals_doc.py`` fails whenever the code and the doc
+disagree — the same sync-test discipline the bench schema uses.
+
+Two populations, split by intent:
+
+- **Declared refusals** — ``raise NotImplementedError("...")`` with a
+  message: a combination the code explicitly refuses (MoE×zero1,
+  serving beyond tp, flax batch-stats, …).  These are the ROADMAP's
+  work items.
+- **Abstract interface slots** — bare ``raise NotImplementedError``:
+  a subclass hook, not a refusal.  Listed separately so the refusal
+  count is honest.
+
+Entries are keyed on (module, qualname, message) — NOT line numbers —
+so unrelated edits don't churn the doc.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+DOC_REL = "docs/REFUSALS.md"
+
+_HEADER = """\
+# REFUSALS — the NotImplementedError matrix
+
+> **Generated** by `python -m theanompi_tpu.analysis --write-refusals`
+> (`theanompi_tpu/analysis/refusals.py`). Do not edit by hand:
+> `tests/test_refusals_doc.py` fails when this file and the code
+> drift. ROADMAP item 4 closes entries out of the first table.
+
+Every `raise NotImplementedError` in `theanompi_tpu/`, split into
+**declared refusals** (a messaged raise: a combination the code
+refuses on purpose — each one is an open work item or a documented
+design boundary) and **abstract interface slots** (bare raises:
+subclass hooks, not refusals).
+"""
+
+
+def _message_of(node: ast.Raise) -> str | None:
+    """Render the raise's message arg, stable across edits: string
+    constants verbatim, f-string holes as ``{…}``, anything else as
+    unparsed source."""
+    exc = node.exc
+    if isinstance(exc, ast.Name):
+        return None                      # bare: abstract slot
+    if not isinstance(exc, ast.Call) or not exc.args:
+        return "" if isinstance(exc, ast.Call) else None
+    parts = []
+    for a in exc.args:
+        if isinstance(a, ast.Constant) and isinstance(a.value, str):
+            parts.append(a.value)
+        elif isinstance(a, ast.JoinedStr):
+            for v in a.values:
+                if isinstance(v, ast.Constant):
+                    parts.append(str(v.value))
+                else:
+                    parts.append("{…}")
+        else:
+            try:
+                parts.append(ast.unparse(a))
+            except Exception:
+                parts.append("…")
+    return " ".join(" ".join(parts).split())
+
+
+def collect(root: Path, package: str = "theanompi_tpu") -> list[dict]:
+    """All NotImplementedError raises under the package, sorted."""
+    entries = []
+    for path in sorted((root / package).rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        rel = str(path.relative_to(root))
+        try:
+            tree = ast.parse(path.read_text(), filename=rel)
+        except SyntaxError:
+            continue
+        def visit(node: ast.AST, q: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.ClassDef, ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    visit(child, f"{q}.{child.name}" if q
+                          else child.name)
+                else:
+                    if isinstance(child, ast.Raise):
+                        name = _exc_name(child)
+                        if name == "NotImplementedError":
+                            entries.append({
+                                "module": rel,
+                                "where": q or "<module>",
+                                "message": _message_of(child),
+                            })
+                    visit(child, q)
+
+        visit(tree, "")
+    entries.sort(key=lambda e: (e["module"], e["where"],
+                                e["message"] or ""))
+    return entries
+
+
+def _exc_name(node: ast.Raise) -> str | None:
+    exc = node.exc
+    if isinstance(exc, ast.Call):
+        exc = exc.func
+    if isinstance(exc, ast.Name):
+        return exc.id
+    if isinstance(exc, ast.Attribute):
+        return exc.attr
+    return None
+
+
+def render(entries: list[dict]) -> str:
+    refusals = [e for e in entries if e["message"] is not None]
+    abstract = [e for e in entries if e["message"] is None]
+    lines = [_HEADER]
+    lines.append(f"## Declared refusals ({len(refusals)})\n")
+    lines.append("| module | where | refuses |")
+    lines.append("|---|---|---|")
+    for e in refusals:
+        msg = (e["message"] or "(no message)").replace("|", "\\|")
+        lines.append(f"| `{e['module']}` | `{e['where']}` | {msg} |")
+    lines.append("")
+    lines.append(f"## Abstract interface slots ({len(abstract)})\n")
+    lines.append("| module | where |")
+    lines.append("|---|---|")
+    for e in abstract:
+        lines.append(f"| `{e['module']}` | `{e['where']}` |")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def write(root: Path) -> Path:
+    out = root / DOC_REL
+    out.write_text(render(collect(root)))
+    return out

@@ -1,0 +1,100 @@
+"""Seed configuration for the tmcheck rule families.
+
+The registry encodes what the serving/control-plane code already
+practices, so the checkers enforce the existing discipline rather
+than invent one:
+
+- :data:`GUARDED_BY` — per-class lock attribute + the attributes that
+  must only be touched with it held (rule TM101).  Seeded for the
+  threaded control-plane classes; ``# guarded-by: _lock`` comments on
+  ``self.attr = ...`` lines in ``__init__`` extend it per file.
+  Attributes owned by a single thread by construction (the engine's
+  slot mirrors, a replica's heartbeat dict) are deliberately NOT
+  registered: the rule checks the lock discipline the code claims,
+  not a fantasy one.
+- :data:`HOT_EXACT` / :data:`HOT_SUBSTR` — function-name seeds for
+  the JAX hot-path sanitizer (TM104/TM105): the decode/prefill/step
+  loops where one host-sync per call is the contract and a
+  per-iteration fence is the PR 6 regression class.  ``# tmcheck:
+  hot`` on a def line opts any other function in; ``test_``-prefixed
+  functions are exempt (tests fence deliberately to assert values).
+- :data:`TRACED_WRAPPERS` — call names whose function-valued
+  arguments become traced bodies (TM106's scope): inside these,
+  wall-clock and host-RNG calls burn into the compiled artifact.
+- :data:`DENY_UNDER_LOCK` — the TM103 deny list, documented in
+  docs/ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+#: class name -> (lock attribute, attributes guarded by it).
+GUARDED_BY: dict[str, tuple[str | None, frozenset]] = {
+    # the fleet router: membership, pending table, dispatch queue and
+    # cursor all mutate under the RLock from submit/watchdog/replica
+    # callback threads
+    "Router": ("_lock", frozenset({
+        "_members", "_pending", "_queue", "_rr", "_ring", "_stopping",
+    })),
+    # the engine: the submit queue is the ONE cross-thread structure
+    # (slots/mirrors are engine-loop-owned by construction)
+    "Engine": ("_lock", frozenset({"_queue"})),
+    # the TCP client: futures + command-reply slots are shared by the
+    # submitting thread, the reader thread, and the pinger
+    "TCPReplicaClient": ("_lock", frozenset({"_futures", "_replies"})),
+    # single-owner loops: no lock-guarded state today; registered so
+    # adding guarded state later starts from an explicit entry
+    "InProcessReplica": (None, frozenset()),
+    "Autoscaler": (None, frozenset()),
+    "Supervisor": (None, frozenset()),
+}
+
+#: hot-path seeds: exact function names …
+HOT_EXACT = frozenset({"step", "decode", "decode_step", "prefill"})
+#: … and substrings (catches `_advance_prefill_slot`,
+#: `_prepare_decode_writes`, and their future siblings)
+HOT_SUBSTR = ("prefill", "decode")
+
+#: call names whose callable arguments are traced (jitted/scanned)
+TRACED_WRAPPERS = frozenset({
+    "jit", "scan", "fori_loop", "while_loop", "cond", "pmap", "vmap",
+    "grad", "value_and_grad", "checkpoint", "remat", "shard_map",
+    "custom_vjp", "custom_jvp",
+})
+
+#: TM103: operations that must not run while holding a lock.  Keys
+#: are symbolic op ids (used in messages); values document the match.
+DENY_UNDER_LOCK = {
+    "future-resolve": "`._set(...)` resolves a future: its done-"
+                      "callbacks run on THIS thread, under the lock",
+    "done-callback": "`.add_done_callback(...)` fires inline when the "
+                     "future already resolved",
+    "unbounded-send": "`send_frame(...)`/`.sendall(...)` without "
+                      "timeout_s: a peer that stops reading wedges "
+                      "the lock holder forever",
+    "blocking-wait": "blocking `.result()`/queue `.get()`/thread "
+                     "`.join()` parks the lock holder",
+    "sleep": "`time.sleep(...)` holds the lock across a stall",
+}
+
+#: receiver-name hints -> class-name keywords, for resolving
+#: `obj.method(...)` call sites to candidate classes in the
+#: lock-order graph (TM102).  A hint that matches no analyzed class
+#: falls back to "all classes defining the method".
+RECEIVER_HINTS = {
+    "engine": "engine",
+    "replica": "replica",
+    "router": "router",
+    "client": "client",
+    "fut": "future",
+    "future": "future",
+    "efut": "future",
+    "recorder": "recorder",
+    "decoder": "decoder",
+    "dec": "decoder",
+    "mgr": "manager",
+    "manager": "manager",
+    "allocator": "allocator",
+    "cache": "cache",
+    "supervisor": "supervisor",
+    "autoscaler": "autoscaler",
+}

@@ -234,7 +234,7 @@ class Engine:
         )
 
         self._lock = threading.Lock()
-        self._queue: deque[_Entry] = deque()
+        self._queue: deque[_Entry] = deque()  # guarded-by: _lock
         self._slots: list[_SlotState | None] = [None] * s
         # device-call mirrors (owned by the engine loop thread)
         self._tokens = np.zeros((s,), np.int32)

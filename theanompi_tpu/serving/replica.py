@@ -251,9 +251,11 @@ class ReplicaServer:
 
     def __init__(self, engine: Engine, *, name: str = "replica",
                  index: int = 0, host: str = "127.0.0.1",
-                 port: int = 0, role: str = "unified"):
+                 port: int = 0, role: str = "unified",
+                 send_timeout_s: float = 30.0):
         self.replica = InProcessReplica(engine, name=name, index=index,
                                         role=role)
+        self.send_timeout_s = float(send_timeout_s)
         self._stopped = threading.Event()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -293,12 +295,27 @@ class ReplicaServer:
         def push(frame) -> None:
             # engine-thread callbacks race the command loop for the
             # socket; a dead connection just drops the frame (the
-            # router requeues on the health signal, not on delivery)
+            # router requeues on the health signal, not on delivery).
+            # send_timeout_s bounds the write: a peer that stops
+            # READING (wedged client, full TCP buffer) would leave a
+            # bare sendall blocked forever while holding send_lock —
+            # parking every engine-thread result callback behind it
+            # and stalling the replica (tmcheck TM103 found it).
             try:
                 with send_lock:
-                    send_frame(conn, frame)
+                    send_frame(conn, frame,
+                               timeout_s=self.send_timeout_s)
             except (OSError, ConnectionError):
-                pass
+                # a timed-out send may have written PART of a frame —
+                # the length-prefixed stream is desynced, so the
+                # connection is unusable: close it so the command
+                # loop's recv fails and the client's reader marks the
+                # wire dead (router requeues), instead of appending
+                # the next frame at an arbitrary byte offset
+                try:
+                    conn.close()
+                except OSError:
+                    pass
 
         try:
             with conn:

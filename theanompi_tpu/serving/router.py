@@ -350,7 +350,7 @@ class Router:
             self._ring.remove(name)
             self._pump_locked()
 
-    def _member_named(self, name: str) -> _Member:
+    def _member_named(self, name: str) -> _Member:  # tmcheck: holds=_lock
         m = next(
             (m for m in self._members if m.name == str(name)), None
         )
@@ -368,10 +368,10 @@ class Router:
                 for m in self._members
             }
 
-    def _healthy(self) -> list[_Member]:
+    def _healthy(self) -> list[_Member]:  # tmcheck: holds=_lock
         return [m for m in self._members if m.healthy]
 
-    def _dispatchable(self) -> list[_Member]:
+    def _dispatchable(self) -> list[_Member]:  # tmcheck: holds=_lock
         return [
             m for m in self._members if m.healthy and not m.draining
         ]
@@ -405,19 +405,28 @@ class Router:
         )
         with self._lock:
             if self._stopping:
-                return self._shed(entry, "shutdown")
-            if len(self._pending) >= self.fleet_queue_cap:
-                return self._shed(entry, "queue_full")
-            self._pending[entry.rid] = entry
-            if self._queue:
-                # FIFO fairness: older router-held requests (back-
-                # pressured or failover-requeued) get first claim on
-                # any freed capacity — a fresh submit must not race
-                # past them to a slot and starve them to "deadline"
-                self._queue.append(entry.rid)
-                self._pump_locked()
-            elif not self._try_dispatch(entry):
-                self._queue.append(entry.rid)
+                reason = "shutdown"
+            elif len(self._pending) >= self.fleet_queue_cap:
+                reason = "queue_full"
+            else:
+                reason = None
+                self._pending[entry.rid] = entry
+                if self._queue:
+                    # FIFO fairness: older router-held requests (back-
+                    # pressured or failover-requeued) get first claim
+                    # on any freed capacity — a fresh submit must not
+                    # race past them to a slot and starve them to
+                    # "deadline"
+                    self._queue.append(entry.rid)
+                    self._pump_locked()
+                elif not self._try_dispatch(entry):
+                    self._queue.append(entry.rid)
+        if reason is not None:
+            # admission sheds resolve OUTSIDE the lock (same shape as
+            # Engine.submit): the entry was never published, so only
+            # this thread can resolve it, and the caller's future
+            # callbacks never run under the router lock
+            return self._shed(entry, reason)
         return entry.future
 
     def _shed(self, entry: _FleetEntry, reason: str) -> ServingFuture:
@@ -441,7 +450,7 @@ class Router:
             and m.replica.load() >= self.replica_queue_cap
         )
 
-    def _candidates(
+    def _candidates(  # tmcheck: holds=_lock
         self, entry: _FleetEntry
     ) -> tuple[list[_Member], str]:
         """Role-aware candidate set + dispatch mode for one entry
@@ -469,7 +478,7 @@ class Router:
             return pre, "prefill"
         return (uni or avail), "unified"
 
-    def _choose(self, entry: _FleetEntry,
+    def _choose(self, entry: _FleetEntry,  # tmcheck: holds=_lock
                 healthy: list[_Member]) -> _Member | None:
         if not healthy:
             return None
@@ -504,7 +513,7 @@ class Router:
                 return m
         return None
 
-    def _try_dispatch(self, entry: _FleetEntry) -> bool:
+    def _try_dispatch(self, entry: _FleetEntry) -> bool:  # tmcheck: holds=_lock
         """Dispatch one pending entry if a member will take it; the
         caller holds the lock.  Expired entries shed here (the
         deadline generalizes across requeues: each redispatch carries
@@ -513,7 +522,12 @@ class Router:
         remaining = entry.deadline_s - (now - entry.submit_t)
         if remaining <= 0:
             del self._pending[entry.rid]
-            self._shed(entry, "deadline")
+            # deliberate resolve-under-RLock: deadline expiry is
+            # found mid-dispatch, and deferring it would let the dead
+            # entry be re-dispatched first.  User callbacks run under
+            # the router RLock (re-entry is safe; callbacks must not
+            # take foreign locks — docs/ANALYSIS.md TM103).
+            self._shed(entry, "deadline")  # tmcheck: disable=TM103
             return True      # terminal — no longer queued
         candidates, mode = self._candidates(entry)
         member = self._choose(entry, candidates)
@@ -546,7 +560,11 @@ class Router:
             handoff=entry.handoff,
         ))
         self.recorder.record_dispatch(member.name)
-        efut.add_done_callback(
+        # deliberate register-under-RLock: an already-resolved efut
+        # fires _on_result inline on THIS thread, which re-enters the
+        # RLock; registering outside the lock would open a window
+        # where a racing requeue misses the generation bump.
+        efut.add_done_callback(  # tmcheck: disable=TM103
             lambda res, rid=entry.rid, gen=gen:
                 self._on_result(rid, gen, res)
         )
@@ -661,7 +679,10 @@ class Router:
             if charge:
                 if entry.n_requeues >= self.max_requeues:
                     del self._pending[entry.rid]
-                    self._shed(entry, "failover")
+                    # deliberate resolve-under-RLock: the failover
+                    # budget is spent mid-sweep; see _try_dispatch's
+                    # deadline shed for the rationale
+                    self._shed(entry, "failover")  # tmcheck: disable=TM103
                     continue
                 entry.n_requeues += 1
             self._queue.append(entry.rid)
