@@ -16,7 +16,11 @@
 #    sharing a 40-token system prompt against a primed radix cache;
 #    asserts prefix hit rate > 0, every request completes, token
 #    accounting is exact, and the decode executable never recompiled
-#    (the in-child compile-counter assertions also gate this).
+#    (the in-child compile-counter assertions also gate this).  The
+#    v5 SPECULATIVE arm rides the same child: the same prompts served
+#    non-speculative then with speculate_k=4 must be BITWISE equal,
+#    with accept_rate > 0, tokens/slot-step > 1, and <= 2 decode
+#    compiles (decode + verify share the budget).
 # 4. serving_fleet: the fleet router in smoke shape — 2 replica
 #    PROCESSES behind the TCP wire, one carrying a
 #    TM_FAULT_AT=1:4:die_replica drill that kills it mid-generation;
@@ -87,6 +91,18 @@ if arm["tokens_completed"] != 4 * 8:
     sys.exit("bench_smoke: paged arm token accounting off: %s" % arm)
 if row["n_decode_compiles"] > 2 or row["n_prefill_compiles"] > 2:
     sys.exit("bench_smoke: paged executables recompiled: %s" % row)
+sd = row.get("spec_decode") or {}
+print("spec decode bitwise", sd.get("bitwise_equal"),
+      "accept_rate", sd.get("accept_rate"),
+      "tokens/step", sd.get("tokens_per_step"))
+if not sd.get("bitwise_equal"):
+    sys.exit("bench_smoke: speculative decode diverged from the "
+             "non-speculative stream: %s" % sd)
+if not (sd.get("accept_rate") or 0) > 0:
+    sys.exit("bench_smoke: speculative arm accepted no drafts: %s" % sd)
+if not (sd.get("tokens_per_step") or 0) > 1:
+    sys.exit("bench_smoke: speculative arm stayed at one "
+             "token/step: %s" % sd)
 print("bench_smoke: serving_paged OK")
 '
 

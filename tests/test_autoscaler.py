@@ -515,3 +515,81 @@ class TestAutoscaleE2E:
             router.stop(drain_s=5.0)
             r0.stop()
             standby.stop()
+
+
+class TestColdSpawnModeling:
+    """``spawn_latency_s`` (ROADMAP item 2 leftover): a cold spawn's
+    startup window is charged against the scale-up budget — the
+    cooldown runs from the replica's READINESS, so sustained
+    backpressure during the cold window defers the next decision
+    instead of double-spawning into capacity that is already
+    booting."""
+
+    def test_slow_spawn_defers_next_decision_no_double_spawn(self):
+        fakes = [FakeReplica("a")]
+        r = fake_router(fakes)
+        spawned = []
+        asc = Autoscaler(
+            r, spawner(spawned), max_replicas=4,
+            scale_up_at=1.5, up_hold_s=0.0, cooldown_s=0.0,
+            spawn_latency_s=5.0,
+        )
+        for _ in range(20):
+            r.submit([1], max_tokens=2)
+        asc.tick()
+        assert len(spawned) == 1
+        r.check_health()
+        # pressure stays high while the spawn is cold: further ticks
+        # must NOT double-spawn (readiness-based cooldown)
+        for _ in range(5):
+            asc.tick()
+        assert len(spawned) == 1
+        s = asc.summary()
+        assert s["spawn_latency_s"] == 5.0
+        assert s["spawn_latency_charged_s"] >= 5.0
+        assert asc.events[0]["spawn_s"] >= 5.0
+        for f in fakes + spawned:
+            f.resolve_all()
+
+    def test_zero_latency_keeps_immediate_rescale(self):
+        fakes = [FakeReplica("a")]
+        r = fake_router(fakes)
+        spawned = []
+        asc = Autoscaler(
+            r, spawner(spawned), max_replicas=4,
+            scale_up_at=1.5, up_hold_s=0.0, cooldown_s=0.0,
+        )
+        for _ in range(50):
+            r.submit([1], max_tokens=2)
+        asc.tick()
+        r.check_health()
+        asc.tick()
+        assert len(spawned) == 2        # no modeled latency: back-to-back
+        assert asc.summary()["spawn_latency_charged_s"] < 1.0
+        for f in fakes + spawned:
+            f.resolve_all()
+
+    def test_ledger_charges_from_decision_time(self):
+        """The replica-seconds ledger bills a booting replica from
+        the DECISION, not from readiness — cold-start time is paid
+        capacity."""
+        fakes = [FakeReplica("a")]
+        r = fake_router(fakes)
+        spawned = []
+        asc = Autoscaler(
+            r, spawner(spawned), max_replicas=2,
+            scale_up_at=1.5, up_hold_s=0.0, cooldown_s=0.0,
+            spawn_latency_s=3.0,
+        )
+        for _ in range(20):
+            r.submit([1], max_tokens=2)
+        t0 = time.monotonic()
+        asc.tick()
+        assert len(spawned) == 1
+        ev = [e for e in r.recorder.scale_events
+              if e["replica"] == spawned[0].name]
+        assert len(ev) == 1 and ev[0]["event"] == "spawn"
+        # stamped at the decision (within the tick), NOT now + 3s
+        assert ev[0]["t"] <= time.monotonic() and ev[0]["t"] >= t0 - 1.0
+        for f in fakes + spawned:
+            f.resolve_all()

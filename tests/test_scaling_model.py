@@ -518,3 +518,47 @@ def test_bsp_efficiency_measured_anchor(devices8):
     # on: efficiency does not improve as the world grows
     eff_meas_2 = 1.0 - m2["trace"]["comm_frac"]
     assert eff_meas <= eff_meas_2 + 0.10, (eff_meas, eff_meas_2)
+
+
+def test_serving_roofline_paged_attend_intensity():
+    """The fused-kernel arithmetic-intensity line (serving v5): the
+    kernel is bandwidth-bound by construction (intensity far under
+    the ridge), and the gather path's materialized window costs ~3x
+    the PADDED window's bytes — the predicted HBM win the
+    serving_paged row's paged_attend_frac A/B measures."""
+    from theanompi_tpu.utils import scaling_model as sm
+
+    r = sm.serving_roofline(
+        LLAMA3_8B, batch=8, context=1024, tp=8, max_seq=8192,
+        block_size=16,
+    )
+    assert r["paged_attend_intensity"] < r["ridge_intensity"]
+    assert r["paged_attend_bytes_fused"] > 0
+    # gather reads+writes+rereads the PADDED window (max_seq-sized
+    # here), fused reads context once: speedup > 3x padding ratio
+    assert r["paged_attend_hbm_speedup"] == pytest.approx(
+        3.0 * 8192 / 1024
+    )
+    # no block_size -> no kernel line
+    r2 = sm.serving_roofline(LLAMA3_8B, batch=8, context=1024, tp=8)
+    assert "paged_attend_intensity" not in r2
+
+
+def test_speculation_speedup_forms():
+    from theanompi_tpu.utils import scaling_model as sm
+
+    # conditional=True: geometric per-draft probability
+    s = sm.speculation_speedup(k=6, accept_rate=0.8, conditional=True)
+    want = sum(0.8 ** i for i in range(6))
+    assert s["tokens_per_step"] == pytest.approx(want)
+    assert s["speedup"] == pytest.approx(want)
+    # default: unconditional accepted/drafted (the recorder datum) —
+    # linear, and always >= the geometric form at the same a
+    u = sm.speculation_speedup(k=6, accept_rate=0.8)
+    assert u["tokens_per_step"] == pytest.approx(1.0 + 0.8 * 5)
+    assert u["tokens_per_step"] > s["tokens_per_step"]
+    for kw in ({}, {"conditional": True}):
+        assert sm.speculation_speedup(k=5, accept_rate=1.0, **kw)[
+            "tokens_per_step"] == 5.0
+        assert sm.speculation_speedup(k=5, accept_rate=0.0, **kw)[
+            "speedup"] == 1.0

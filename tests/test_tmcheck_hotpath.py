@@ -238,3 +238,52 @@ class TestSuppressionTracking:
         # clean line and is itself flagged as stale
         assert rules_of(out) == ["TM201"]
         assert "matches no finding" in out[0].message
+
+
+class TestSpeculativeVerifyFences:
+    """The speculative hot path (TM104 seeds "verify"/"draft",
+    serving v5): a per-draft-token host fence inside the verify loop
+    is the PR 6 per-chunk-fence bug class one level deeper — each
+    draft's readback would serialize the verify window the
+    fixed-shape executable exists to batch."""
+
+    def test_per_draft_token_int_fence_flagged(self):
+        out = run("""
+            class Eng:
+                def _spec_verify(self, drafts, key):
+                    toks = []
+                    for d in drafts:
+                        out = self._verify_jit(True)(d, key)
+                        toks.append(int(out))
+                    return toks
+        """)
+        assert rules_of(out) == ["TM104"]
+        assert "per-iteration int() fence" in out[0].message
+
+    def test_one_verify_dispatch_per_window_clean(self):
+        # the shipped shape (Engine._spec_decode_once): ONE verify
+        # dispatch for the whole window, one readback after
+        out = run("""
+            import numpy as np
+
+            class Eng:
+                def _spec_verify(self, drafts, key):
+                    out = self._verify_jit(True)(drafts, key)
+                    return np.asarray(out)
+        """)
+        assert out == []
+
+    def test_drafter_is_hot_but_host_pure_clean(self):
+        # the n-gram drafter is seeded ("draft") but touches no
+        # device values — pure host list work stays clean
+        out = run("""
+            class Drafter:
+                def draft(self, history, k):
+                    out = []
+                    for n in range(3, 0, -1):
+                        if history[-n:] == history[:n]:
+                            out = history[n:n + k]
+                            break
+                    return out
+        """)
+        assert out == []

@@ -49,10 +49,15 @@ GUARDED_BY: dict[str, tuple[str | None, frozenset]] = {
 }
 
 #: hot-path seeds: exact function names …
-HOT_EXACT = frozenset({"step", "decode", "decode_step", "prefill"})
+HOT_EXACT = frozenset({
+    "step", "decode", "decode_step", "prefill", "verify", "draft",
+})
 #: … and substrings (catches `_advance_prefill_slot`,
-#: `_prepare_decode_writes`, and their future siblings)
-HOT_SUBSTR = ("prefill", "decode")
+#: `_prepare_decode_writes`, `_spec_decode_once`, `_verify_body` and
+#: their future siblings — "verify"/"draft" cover the speculative
+#: path, where a per-draft-token host fence inside the verify loop
+#: is the PR 6 per-chunk-fence bug class one level deeper)
+HOT_SUBSTR = ("prefill", "decode", "verify", "draft")
 
 #: call names whose callable arguments are traced (jitted/scanned)
 TRACED_WRAPPERS = frozenset({

@@ -119,7 +119,23 @@ def sharded_sample(logits_loc, vocab: int, keys, temperature,
     ``keys``: [N, 2] uint32 PRNG keys, one per row (already folded
     with the row's position — the caller owns the fold policy).
     Returns [N] int32 global token ids.
+
+    Higher-rank inputs ([..., V/tp] logits with [..., 2] keys and
+    [...] temperatures) flatten to rows, sample, and reshape back:
+    every row is sampled exactly as in a flat batch.  The decoder's
+    speculative verify step pre-flattens its [S, k] rows itself
+    (``_verify_body``) — this branch keeps the PUBLIC sampler
+    contract honest for multi-row callers that don't, with the
+    flat-vs-shaped bitwise equality under test.
     """
+    lead = logits_loc.shape[:-1]
+    if len(lead) > 1:
+        flat = sharded_sample(
+            logits_loc.reshape(-1, logits_loc.shape[-1]), vocab,
+            keys.reshape(-1, keys.shape[-1]),
+            temperature.reshape(-1), axis_name,
+        )
+        return flat.reshape(lead)
     v_loc, off = vocab_shard_info(vocab, axis_name)
     x = logits_loc.astype(jnp.float32)
     g = jax.vmap(

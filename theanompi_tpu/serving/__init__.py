@@ -63,7 +63,13 @@ from theanompi_tpu.serving.kv_transfer import (
     handoff_bytes,
     inject_handoff,
 )
+# NOTE: serving.paged_attention (the fused Pallas kernel) is NOT
+# re-exported here — the decoder imports it lazily so fleet/router
+# code that never selects paged_attend_impl="pallas" keeps
+# jax.experimental.pallas off its import path; import
+# `theanompi_tpu.serving.paged_attention.paged_attend` directly.
 from theanompi_tpu.serving.prefix_cache import PrefixCache
+from theanompi_tpu.serving.speculation import NGramDrafter
 from theanompi_tpu.serving.replica import (
     InProcessReplica,
     ReplicaServer,
@@ -84,6 +90,7 @@ __all__ = [
     "Engine",
     "InProcessReplica",
     "LlamaDecoder",
+    "NGramDrafter",
     "OutOfBlocks",
     "POLICIES",
     "PagedLlamaDecoder",

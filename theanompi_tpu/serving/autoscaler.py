@@ -90,6 +90,7 @@ class Autoscaler:
         down_hold_s: float = 1.0,
         cooldown_s: float = 0.5,
         interval_s: float = 0.05,
+        spawn_latency_s: float = 0.0,
         default_slots: int = 1,
         index: int = 0,
         manage=None,
@@ -117,6 +118,20 @@ class Autoscaler:
         self.down_hold_s = float(down_hold_s)
         self.cooldown_s = float(cooldown_s)
         self.interval_s = float(interval_s)
+        # COLD-spawn modeling (ROADMAP item 2 leftover): a real
+        # scale-up pays `serve_replica_main` startup — process spawn,
+        # jax import, executable compiles — before the new member
+        # serves a token.  `spawn_latency_s` charges that window
+        # against the scale-up budget: the post-action cooldown is
+        # measured from the replica's READINESS (spawn call + the
+        # larger of the modeled latency and the measured spawn wall
+        # time), so the backpressure that persists while the spawn is
+        # cold DEFERS the next scale decision instead of
+        # double-spawning into it.  The ledger charges from the
+        # DECISION (record_spawn at call time): a booting replica is
+        # paid-for capacity.
+        self.spawn_latency_s = float(spawn_latency_s)
+        self.spawn_latency_charged_s = 0.0
         self.default_slots = int(default_slots)
         self.index = int(index)
         self.verbose = bool(verbose)
@@ -175,17 +190,26 @@ class Autoscaler:
         if len(self._managed_alive()) >= self.max_replicas:
             return False
         replica = self.spawn(self._spawn_idx)
+        spawn_s = max(
+            self.spawn_latency_s, time.monotonic() - now
+        )
         self._spawn_idx += 1
         name = self.router.add_replica(replica)
         self.managed.add(name)
-        self.router.recorder.record_spawn(name, reason=why)
+        # billed from the DECISION: the cold-start window is charged
+        # replica-seconds even though no token serves during it
+        self.router.recorder.record_spawn(name, t=now, reason=why)
         self.events.append({
             "event": "spawn", "replica": name, "t": now,
-            "reason": why,
+            "reason": why, "spawn_s": spawn_s,
         })
-        self._last_action_t = now
+        self.spawn_latency_charged_s += spawn_s
+        # cooldown from READINESS, not from the decision — pressure
+        # observed while the spawn is still cold must not trigger a
+        # second spawn the first one was already bought to relieve
+        self._last_action_t = now + spawn_s
         self._above_since = self._below_since = None
-        self._say(f"scale-up -> {name} ({why})")
+        self._say(f"scale-up -> {name} ({why}, spawn {spawn_s:.2f}s)")
         return True
 
     def _scale_down(self, now: float, why: str) -> bool:
@@ -296,6 +320,8 @@ class Autoscaler:
             "dead": self.dead,
             "death_cause": self.death_cause,
             "last_pressure": self.last_pressure,
+            "spawn_latency_s": self.spawn_latency_s,
+            "spawn_latency_charged_s": self.spawn_latency_charged_s,
             "managed": sorted(self.managed),
             "n_scale_ups": sum(
                 e["event"] == "spawn" for e in self.events

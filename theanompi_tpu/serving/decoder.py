@@ -160,10 +160,12 @@ class LlamaDecoder:
         ]
 
         # compiled variants: decode keyed by the static all-greedy
-        # flag, prefill by (bucket/chunk, greedy) — the compile count
-        # is bounded by 2 x the shape-key count, a tested guarantee
+        # flag, prefill by (bucket/chunk, greedy), the speculative
+        # verify step by (k, greedy) — the compile count is bounded
+        # by 2 x the shape-key count, a tested guarantee
         self._decode_fns: dict[bool, object] = {}
         self._prefill_fns: dict[tuple[int, bool], object] = {}
+        self._verify_fns: dict[tuple[int, bool], object] = {}
 
     def _zeros_cache(self, shape):
         """Per-layer {k, v} zeros of ``shape``, kv-head dim sharded
@@ -432,11 +434,18 @@ class LlamaDecoder:
 
     @property
     def n_decode_compiles(self) -> int:
-        """Compiled decode variants so far — bounded by 2 (greedy
-        fast path + sampling).  The bench's serving sweep asserts
-        this never grows with batch composition, table contents, or
-        offered load."""
-        return len(self._decode_fns)
+        """Compiled decode-phase variants so far — plain decode AND
+        speculative verify executables.  Each family is bounded by 2
+        (greedy fast path + sampling), and one ENGINE dispatches one
+        family (plain decode, or verify at its fixed ``k``), so the
+        count never grows with batch composition, table contents,
+        draft contents, or offered load — the bench sweep asserts
+        ≤ 2 in-child.  A decoder shared by speculative AND
+        non-speculative engines under mixed temperatures can
+        legitimately reach 4 (both families, both sampling modes);
+        what is bounded is the set of shapes, never per-request
+        recompiles."""
+        return len(self._decode_fns) + len(self._verify_fns)
 
     def kv_cache_bytes(self) -> int:
         """Total HBM the KV cache occupies (all layers, global across
@@ -536,8 +545,28 @@ class PagedLlamaDecoder(LlamaDecoder):
         n_blocks: int | None = None,
         prefill_chunk: int | None = None,
         prefix_cache: bool = True,
+        paged_attend_impl: str = "gather",
+        pallas_interpret: bool | None = None,
     ):
+        from theanompi_tpu.serving.paged_attention import IMPLS
+
         self._init_common(model, max_slots, max_seq)
+        if paged_attend_impl not in IMPLS:
+            raise ValueError(
+                f"paged_attend_impl must be one of {IMPLS}, got "
+                f"{paged_attend_impl!r}"
+            )
+        # "gather" = the jnp block-table gather (the reference
+        # oracle); "pallas" = the fused kernel
+        # (serving/paged_attention.py).  The kernel runs through the
+        # Pallas interpreter off-TPU (this CPU image) and compiles
+        # through Mosaic on a real TPU — pallas_interpret overrides
+        # the backend autodetect for tests
+        self.paged_attend_impl = paged_attend_impl
+        self._pallas_interpret = (
+            bool(pallas_interpret) if pallas_interpret is not None
+            else jax.default_backend() != "tpu"
+        )
         self.block_size = int(block_size)
         self.manager = BlockManager(
             n_blocks=None if n_blocks is None else int(n_blocks),
@@ -599,6 +628,53 @@ class PagedLlamaDecoder(LlamaDecoder):
 
         return one(pool["k"]), one(pool["v"])
 
+    def _paged_attend(self, lp, tables, q, pos):
+        """Block-table attention for Q query rows per slot: ``q``
+        [S, Q, h_loc, hd], ``pos`` [S, Q] (row (s, j) attends
+        positions <= pos[s, j]) → o [S, Q, h_loc*hd].  ONE copy of
+        the attend math for decode (Q=1) and the speculative verify
+        step (Q=k); ``paged_attend_impl`` selects the jnp gather
+        reference or the fused Pallas kernel
+        (serving/paged_attention.py) — bitwise-equal for fp32, which
+        is what makes the gather path the kernel's testable oracle."""
+        s, nq = q.shape[:2]
+        hd, hkv_loc, rep = self._hd, self._hkv_loc, self._rep
+        t_pad = self.max_blocks * self.block_size
+        with jax.named_scope("paged_attend"):
+            qg = q.reshape(s, nq, hkv_loc, rep, hd)
+            if self.paged_attend_impl == "pallas":
+                from theanompi_tpu.serving.paged_attention import (
+                    paged_attend,
+                )
+
+                o = paged_attend(
+                    qg, lp["k"], lp["v"], tables, pos,
+                    interpret=self._pallas_interpret,
+                )
+            else:
+                kg, vg = self._gather_kv(lp, tables)
+                valid = (
+                    jnp.arange(t_pad)[None, None, :] <= pos[:, :, None]
+                )[:, :, None, None, :]               # [S, Q, 1, 1, T]
+                scores = jnp.einsum(
+                    "sjkrd,sktd->sjkrt", qg, kg
+                ).astype(jnp.float32) * (hd ** -0.5)
+                scores = jnp.where(valid, scores, NEG_INF)
+                probs = jax.nn.softmax(scores, axis=-1)
+                # prob-weighted V as broadcast-mult + reduce over t
+                # (NOT a dot_general): XLA's batched matvec lowering
+                # reassociates the t-reduction when the row dim
+                # degenerates to 1 (tp=8's hkv=rep=1 decode), which
+                # would break fp32-bitwise equality with the Pallas
+                # kernel's per-cell compute — reduce lowering is
+                # association-stable across batching, matmul is not
+                o = jnp.sum(
+                    probs.astype(vg.dtype)[..., None]
+                    * vg[:, None, :, None, :, :],
+                    axis=-2,
+                )
+            return o.reshape(s, nq, self._h_loc * hd)
+
     def _decode_body(self, params, pools, tables, tokens, lengths,
                      keys, temps, active, greedy: bool):
         """One token for all slots through the block tables.
@@ -608,17 +684,11 @@ class PagedLlamaDecoder(LlamaDecoder):
         m = self.model
         s = self.max_slots
         bs = self.block_size
-        t_pad = self.max_blocks * bs
-        hd, h_loc, hkv_loc, rep = (
-            self._hd, self._h_loc, self._hkv_loc, self._rep
-        )
+        hd, h_loc, hkv_loc = self._hd, self._h_loc, self._hkv_loc
         x = tp_lib.embed_lookup(
             tokens[:, None], params["embed"], m.vocab
         )[:, 0, :].astype(self._cdtype)                       # [S, D]
         pos = lengths                          # write position per slot
-        valid = (
-            jnp.arange(t_pad)[None, :] <= pos[:, None]
-        )[:, None, None, :]                            # [S, 1, 1, T]
         bidx = jnp.clip(pos // bs, 0, self.max_blocks - 1)
         wbid = jnp.where(
             active, tables[jnp.arange(s), bidx], self.trash_id
@@ -635,23 +705,97 @@ class PagedLlamaDecoder(LlamaDecoder):
             k = rope_at(k, pos)
             lp = self._write_kv(layer_pool, k, v, wbid, woff)
             new_pools.append(lp)
-            with jax.named_scope("paged_attend"):
-                kg, vg = self._gather_kv(lp, tables)  # [S, Hkv, T, hd]
-                qg = q.reshape(s, hkv_loc, rep, hd)
-                scores = jnp.einsum("skrd,sktd->skrt", qg, kg).astype(
-                    jnp.float32
-                ) * (hd ** -0.5)
-                scores = jnp.where(valid, scores, NEG_INF)
-                probs = jax.nn.softmax(scores, axis=-1)
-                o = jnp.einsum(
-                    "skrt,sktd->skrd", probs.astype(vg.dtype), vg
-                ).reshape(s, h_loc * hd)
+            o = self._paged_attend(
+                lp, tables, q[:, None], pos[:, None]
+            )[:, 0]
             x = x + tp_lib.row_parallel(o, p["wo"]).astype(self._cdtype)
             x = self._mlp(p, x)
 
         xf = rms_norm(x, params["final_norm"])
         logits = tp_lib.col_parallel(xf, params["lm_head"])  # [S, V/tp]
         nxt = self._sample(logits, keys, pos, temps, greedy)
+        return new_pools, nxt
+
+    def _verify_body(self, params, pools, tables, tokens, lengths,
+                     keys, temps, n_valid, greedy: bool):
+        """Speculative VERIFY step: ``k`` tokens for all slots in one
+        fixed-shape executable (the multi-token sibling of
+        ``_decode_body``).
+
+        ``tokens`` [S, K] int32 — column 0 is the slot's committed
+        current token (what ``decode`` would consume), columns 1..K-1
+        the drafter's proposals; ``lengths`` [S] the write position
+        of column 0; ``n_valid`` [S] int32 in [0, K] — columns >=
+        n_valid route their K/V writes to the trash block (0 = the
+        slot is inactive; over-provisioned draft writes are maskable
+        by the same discipline).  Returns (pools, out [S, K]) where
+        ``out[s, j]`` is the token the model emits after consuming
+        ``tokens[s, :j+1]`` — row j's compute is exactly what
+        ``decode`` would compute at position ``lengths[s]+j`` with
+        that prefix committed (same per-row matmuls, same position
+        mask, same fold-by-position sampling), which is what makes
+        accept-by-equality bitwise-equivalent to sequential decode.
+
+        Rejected drafts need no explicit rollback: positions past the
+        first rejection hold garbage K/V, but the accept logic
+        commits the engine's lengths BELOW them, and the next verify
+        window's writes cover every garbage position before any query
+        row's mask can reach it (writes precede the gather within
+        each layer)."""
+        m = self.model
+        s, kq = tokens.shape
+        bs = self.block_size
+        hd, h_loc, hkv_loc = self._hd, self._h_loc, self._hkv_loc
+        x = tp_lib.embed_lookup(
+            tokens, params["embed"], m.vocab
+        ).astype(self._cdtype)                             # [S, K, D]
+        pos = lengths[:, None] + jnp.arange(kq)[None, :]     # [S, K]
+        in_range = jnp.arange(kq)[None, :] < n_valid[:, None]
+        bidx = jnp.clip(pos // bs, 0, self.max_blocks - 1)
+        wbid = jnp.where(
+            in_range, jnp.take_along_axis(tables, bidx, axis=1),
+            self.trash_id,
+        )                                                    # [S, K]
+        woff = pos % bs
+        pos_f = pos.reshape(-1)
+
+        def flat(a):
+            return a.reshape(s * kq, *a.shape[2:])
+
+        new_pools = []
+        for layer_pool, p in zip(pools, params["layers"]):
+            xn = rms_norm(x, p["attn_norm"])
+            q = tp_lib.col_parallel(xn, p["wq"]).reshape(
+                s, kq, h_loc, hd
+            )
+            k = tp_lib.col_parallel(xn, p["wk"]).reshape(
+                s, kq, hkv_loc, hd
+            )
+            v = tp_lib.col_parallel(xn, p["wv"]).reshape(
+                s, kq, hkv_loc, hd
+            )
+            # rope_at over the flattened rows: per-row rotation at
+            # the row's own position, the same vmap decode uses
+            q = rope_at(flat(q), pos_f).reshape(s, kq, h_loc, hd)
+            k = rope_at(flat(k), pos_f).reshape(s, kq, hkv_loc, hd)
+            lp = self._write_kv(
+                layer_pool, flat(k), flat(v),
+                wbid.reshape(-1), woff.reshape(-1),
+            )
+            new_pools.append(lp)
+            o = self._paged_attend(lp, tables, q, pos)   # [S,K,Hl*hd]
+            x = x + tp_lib.row_parallel(o, p["wo"]).astype(self._cdtype)
+            x = self._mlp(p, x)
+
+        xf = rms_norm(x, params["final_norm"])
+        logits = tp_lib.col_parallel(xf, params["lm_head"])
+        keys_f = jnp.broadcast_to(
+            keys[:, None, :], (s, kq, 2)
+        ).reshape(s * kq, 2)
+        temps_f = jnp.broadcast_to(temps[:, None], (s, kq)).reshape(-1)
+        nxt = self._sample(
+            logits.reshape(s * kq, -1), keys_f, pos_f, temps_f, greedy
+        ).reshape(s, kq)
         return new_pools, nxt
 
     def _prefill_body(self, params, pools, table_row, ids, start,
@@ -766,6 +910,26 @@ class PagedLlamaDecoder(LlamaDecoder):
                 donate_argnums=(1,),
             )
             self._prefill_fns[(self.prefill_chunk, greedy)] = fn
+        return fn
+
+    def _verify_jit(self, k: int, greedy: bool):
+        fn = self._verify_fns.get((k, greedy))
+        if fn is None:
+            import functools
+
+            rep = P()
+            fn = jax.jit(
+                jax.shard_map(
+                    functools.partial(self._verify_body, greedy=greedy),
+                    mesh=self.mesh,
+                    in_specs=(self.model._specs, self._cache_specs,
+                              rep, rep, rep, rep, rep, rep),
+                    out_specs=(self._cache_specs, rep),
+                    check_vma=False,
+                ),
+                donate_argnums=(1,),
+            )
+            self._verify_fns[(k, greedy)] = fn
         return fn
 
     def _copy_jit(self):
@@ -947,6 +1111,28 @@ class PagedLlamaDecoder(LlamaDecoder):
             jnp.asarray(keys, jnp.uint32),
             jnp.asarray(temps, jnp.float32),
             jnp.asarray(active, bool),
+        )
+        return np.asarray(nxt)
+
+    def verify(self, tokens, lengths, keys, temps, tables,
+               n_valid) -> np.ndarray:
+        """One speculative verify step for all slots: ``tokens``
+        [S, K] (column 0 committed, rest drafts), ``n_valid`` [S]
+        (0 = inactive slot).  Host arrays in, host token matrix
+        [S, K] out — the single ``np.asarray`` read is the step's
+        device fence, same discipline as ``decode``.  The engine owns
+        accept/reject; this is pure device math."""
+        tokens = np.asarray(tokens, np.int32)
+        self.pools, nxt = self._verify_jit(
+            tokens.shape[1], bool(np.all(np.asarray(temps) <= 0.0))
+        )(
+            self.model.params, self.pools,
+            jnp.asarray(tables, jnp.int32),
+            jnp.asarray(tokens),
+            jnp.asarray(lengths, jnp.int32),
+            jnp.asarray(keys, jnp.uint32),
+            jnp.asarray(temps, jnp.float32),
+            jnp.asarray(n_valid, jnp.int32),
         )
         return np.asarray(nxt)
 

@@ -198,6 +198,8 @@ class Engine:
         chunked_prefill: bool | None = None,
         prefill_chunks_per_step: int = 1,
         prefix_caching: bool = True,
+        speculate_k: int = 0,
+        drafter=None,
     ):
         self.decoder = decoder
         self.queue_cap = int(queue_cap)
@@ -232,6 +234,35 @@ class Engine:
         self._evictable = (
             decoder.prefix_cache if self._paged else None
         )
+
+        # speculative decoding (serving v5): k tokens per VERIFY step
+        # (1 committed + up to k-1 drafted), accept-by-equality —
+        # bitwise-equal to sequential decode at every temperature
+        # because sampling is deterministic given (seed, position).
+        # 0/1 = off (plain one-token decode_step).
+        self.speculate_k = int(speculate_k)
+        if self.speculate_k >= 2 and not self._paged:
+            raise NotImplementedError(
+                "speculative decoding serves through the paged "
+                "decoder only — the verify window's over-provisioned "
+                "KV writes need the trash-block discipline "
+                "(PagedLlamaDecoder); rebuild with paged=True"
+            )
+        if self.speculate_k >= 2:
+            if drafter is None:
+                from theanompi_tpu.serving.speculation import (
+                    NGramDrafter,
+                )
+
+                drafter = NGramDrafter()
+            self.drafter = drafter
+        else:
+            self.drafter = None
+        self._draft = np.zeros((s, max(1, self.speculate_k)), np.int32)
+        self._n_valid = np.zeros((s,), np.int32)
+        self._step_drafted = 0
+        self._step_accepted = 0
+        self._step_slots = 0
 
         self._lock = threading.Lock()
         self._queue: deque[_Entry] = deque()  # guarded-by: _lock
@@ -631,6 +662,131 @@ class Engine:
             except OutOfBlocks:
                 self._finish(slot, "no_blocks")
 
+    def _draft_history(self, st: _SlotState, req: Request) -> list:
+        """The drafter's view of the slot's tokens, bounded to the
+        drafter's own scan window when it declares one — rebuilding
+        the full prompt+generated list every step would put an
+        O(prompt_len) host copy on the decode cadence only for the
+        drafter to slice its tail off."""
+        scan = getattr(self.drafter, "max_scan", None)
+        if scan is None:
+            return list(req.prompt) + st.generated
+        if len(st.generated) >= scan:
+            return st.generated[-scan:]
+        head = scan - len(st.generated)
+        return list(req.prompt[-head:]) + st.generated
+
+    def _prepare_spec_decode_writes(self) -> None:
+        """The speculative sibling of ``_prepare_decode_writes``:
+        draft up to ``speculate_k - 1`` tokens per decoding slot
+        (window clamped so every write position stays inside
+        ``max_seq`` — a slot near the cap verifies a shorter window,
+        floor one token), then grow the table and pass EVERY block
+        the window touches through the CoW gate.  Block scarcity
+        degrades the window to one token (the plain-decode
+        reservation) before it becomes a ``no_blocks`` finish, so
+        speculation never truncates a request the non-speculative
+        path would have served."""
+        dec = self.decoder
+        bs = dec.block_size
+        self._n_valid[:] = 0
+        for slot, st in enumerate(self._slots):
+            if st is None or st.state != "decode":
+                continue
+            pos = int(self._lengths[slot])
+            req = st.entry.request
+            # window clamped by the cache (max_seq) AND the request's
+            # remaining token budget — drafting past either buys
+            # block growth/CoW and drafted-counter noise for tokens
+            # the emit loop is guaranteed to cut (both floors are
+            # >= 1 for a live decode slot)
+            want = min(
+                self.speculate_k,
+                dec.max_seq - pos,
+                req.max_tokens - len(st.generated),
+            )
+            draft: list = []
+            if want > 1:
+                draft = list(self.drafter.draft(
+                    self._draft_history(st, req), want - 1
+                ))[: want - 1]
+            n = 1 + len(draft)
+            while True:
+                try:
+                    last_bidx = (pos + n - 1) // bs
+                    need = last_bidx + 1 - self._mgr.n_owned[slot]
+                    if need > 0:
+                        self._try_blocks(need)   # best-effort evict
+                    self._mgr.grow(slot, last_bidx)
+                    for bidx in range(pos // bs, last_bidx + 1):
+                        self._cow_gate(slot, bidx)
+                    break
+                except OutOfBlocks:
+                    if n > 1:
+                        # degrade to the non-speculative window
+                        n, draft = 1, []
+                        continue
+                    self._finish(slot, "no_blocks")
+                    n = 0
+                    break
+            if n:
+                self._n_valid[slot] = n
+                self._draft[slot, 0] = self._tokens[slot]
+                self._draft[slot, 1:n] = draft
+                self._draft[slot, n:] = 0
+
+    def _spec_decode_once(self) -> int:
+        """One verify step + host-side accept: commit the longest
+        draft prefix the model reproduced, plus the model's own next
+        token.  Emission replays the per-token eviction rules of the
+        sequential path EXACTLY (EOS / max_tokens / max_seq checked
+        token by token), so an EOS mid-window stops at the EOS with
+        no overshoot and the finish reasons match the
+        non-speculative run."""
+        self._prepare_spec_decode_writes()
+        if not self._decoding_slots():
+            return 0
+        out = self.decoder.verify(
+            self._draft, self._lengths, self._keys, self._temps,
+            self._mgr.tables, self._n_valid,
+        )
+        now = time.monotonic()
+        emitted = 0
+        for slot, st in enumerate(self._slots):
+            if st is None or st.state != "decode":
+                continue
+            kv = int(self._n_valid[slot])
+            if kv < 1:
+                continue
+            self._step_slots += 1
+            row = out[slot]
+            # accepted prefix: drafts the model itself emitted
+            a = 0
+            while a < kv - 1 and row[a] == self._draft[slot, a + 1]:
+                a += 1
+            self._step_drafted += kv - 1
+            req = st.entry.request
+            n_emit = 0
+            for i in range(a + 1):
+                tok = int(row[i])
+                self._lengths[slot] += 1
+                self._tokens[slot] = tok
+                st.generated.append(tok)
+                st.last_tok_t = now
+                emitted += 1
+                n_emit += 1
+                if self.eos_id is not None and tok == self.eos_id:
+                    self._finish(slot, "eos")
+                    break
+                elif len(st.generated) >= req.max_tokens:
+                    self._finish(slot, "max_tokens")
+                    break
+                elif self._lengths[slot] >= self.decoder.max_seq:
+                    self._finish(slot, "max_seq")
+                    break
+            self._step_accepted += max(0, n_emit - 1)
+        return emitted
+
     def _admit(self, now: float) -> None:
         """Fill free slots from the queue head — a prefill each, so
         the admitted request rides the very next decode step."""
@@ -667,6 +823,10 @@ class Engine:
         )
 
     def _decode_once(self) -> int:
+        self._step_drafted = self._step_accepted = 0
+        self._step_slots = 0
+        if self.speculate_k >= 2:
+            return self._spec_decode_once()
         if self._paged:
             self._prepare_decode_writes()
             if not self._decoding_slots():
@@ -690,6 +850,7 @@ class Engine:
             st.generated.append(tok)
             st.last_tok_t = now
             emitted += 1
+            self._step_slots += 1
             req = st.entry.request
             if self.eos_id is not None and tok == self.eos_id:
                 self._finish(slot, "eos")
@@ -745,8 +906,15 @@ class Engine:
                 blocks_in_use=alloc.blocks_in_use,
                 blocks_free=alloc.blocks_free,
             )
+        if self.speculate_k >= 2:
+            gauges.update(
+                drafted=self._step_drafted,
+                accepted=self._step_accepted,
+            )
         self.recorder.record_step(
-            active_slots=emitted,  # the batch that actually decoded
+            # the batch that actually decoded — under speculation a
+            # slot can emit several tokens, so slots and tokens part
+            active_slots=self._step_slots,
             queue_depth=self.queue_depth(),
             dt_s=time.monotonic() - t0,
             tokens=emitted,

@@ -1,0 +1,166 @@
+"""Fused gather+attend Pallas kernel for paged KV-cache decode
+(serving speed-of-light, ROADMAP item 1b).
+
+The jnp gather path (``PagedLlamaDecoder._gather_kv``) materializes
+the block-table read as a ``[S, Hkv, MB*bs, hd]`` tensor per layer —
+PR 6's decode-cost attribution (``paged_attend_frac`` in the
+``serving_paged`` bench row) puts most of decode time there, and on
+real hardware that tensor is an HBM round trip: the pool rows are
+READ, WRITTEN back as the gathered copy, and READ again by the
+attention matmuls (~3x the padded window's bytes).  This kernel fuses
+the walk: each grid cell (slot, kv-head) DMAs its slot's blocks from
+the HBM pools straight into contiguous VMEM scratch — the gathered
+history never exists in HBM — and computes the attention against it
+in place.  KV bytes move once, at the fused arithmetic intensity
+``serving_roofline`` models (``paged_attend_intensity``).
+
+Exactness contract: the kernel mirrors the gather oracle's op
+sequence exactly — same einsum contractions, same ``astype(f32) *
+hd**-0.5`` scale, same ``where(pos-mask, ·, NEG_INF)`` +
+``jax.nn.softmax`` — so for fp32 pools the outputs are BITWISE equal
+to the gather path (tests/test_paged_attention.py asserts exact
+equality across block-boundary, ragged-length and trash-padding
+cases).  That makes the gather path the kernel's reference oracle:
+``interpret=True`` runs the kernel through the Pallas interpreter on
+this CPU image (testable here), and the same code compiles through
+Mosaic on a real TPU unchanged (``interpret=False`` — the decoder
+flips it by backend).
+
+Shapes (all per tp shard — the decoder calls this inside shard_map,
+so ``hkv``/``rep`` are the LOCAL head counts):
+
+- ``q``      ``[S, Q, Hkv, rep, hd]`` — Q query rows per slot (1 for
+  plain decode, ``k`` for a speculative verify step);
+- ``k_pool``/``v_pool`` ``[n_blocks + 1, Hkv, bs, hd]`` (last row =
+  trash block);
+- ``tables`` ``[S, MB]`` int32 (trash-padded past the owned prefix);
+- ``pos``    ``[S, Q]`` int32 — row (s, q) attends positions
+  ``<= pos[s, q]``.
+
+Table entries and positions are SCALAR-PREFETCH arguments
+(``PrefetchScalarGridSpec``): the block ids must be known before the
+kernel body runs to program the DMAs.  Trash-padded table entries are
+walked too — their positions sit past every ``pos``, so the mask
+kills them (the same branch-free discipline as the gather path).
+
+VMEM budget per grid cell: ``2 * MB * bs * hd * itemsize`` for the
+K/V scratch (e.g. 4 MiB at ctx 8192, hd 128, bf16) — within the
+~16 MiB/core budget for serving-sized contexts; longer contexts want
+a second grid axis over the window, which changes the softmax
+association and therefore the exactness bar (documented, not built).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from theanompi_tpu.ops.attention import NEG_INF
+
+IMPLS = ("gather", "pallas")
+
+
+def _paged_attend_kernel(tables_ref, pos_ref, q_ref, kp_ref, vp_ref,
+                         o_ref, ks, vs, ksem, vsem, *,
+                         mb: int, bs: int, nq: int, scale: float):
+    """One (slot, kv-head) cell: DMA the slot's ``mb`` blocks into
+    contiguous VMEM, then attend each of the ``nq`` query rows
+    against the gathered window under its own position mask."""
+    s = pl.program_id(0)
+    h = pl.program_id(1)
+
+    def block_dma(b, bid):
+        return (
+            pltpu.make_async_copy(
+                kp_ref.at[bid, h], ks.at[pl.ds(b * bs, bs)], ksem.at[b]
+            ),
+            pltpu.make_async_copy(
+                vp_ref.at[bid, h], vs.at[pl.ds(b * bs, bs)], vsem.at[b]
+            ),
+        )
+
+    # the block-table walk: start every block's K and V copy (the DMA
+    # engines pipeline them), then wait once per block
+    for b in range(mb):
+        for dma in block_dma(b, tables_ref[s, b]):
+            dma.start()
+    for b in range(mb):
+        for dma in block_dma(b, tables_ref[s, b]):
+            dma.wait()
+
+    kg = ks[:]                                   # [MB*bs, hd]
+    vg = vs[:]
+    # EXACTLY the gather oracle's op sequence (decoder
+    # `paged_attend` scope): einsum in compute dtype over ALL query
+    # rows at once (so the matmul's row count matches the oracle's
+    # per-(slot, head) row group — XLA's matvec lowering is row-count
+    # sensitive), f32 cast, scale, per-row position mask, softmax,
+    # then prob-weighted V as mult+reduce (NOT a dot_general): reduce
+    # lowering is association-stable across batching, matmul is not.
+    # The fp32-bitwise-equality contract with the gather path lives
+    # here; decoder._paged_attend documents the other half.
+    rep = q_ref.shape[3]
+    q2 = q_ref[0, :, 0].reshape(nq * rep, -1)    # [nq*rep, hd]
+    sc = jnp.einsum("rd,td->rt", q2, kg).astype(jnp.float32) * scale
+    t_idx = jax.lax.broadcasted_iota(jnp.int32, sc.shape, 1)
+    pos_col = jnp.concatenate(
+        [jnp.full((rep, 1), pos_ref[s, j], jnp.int32)
+         for j in range(nq)], axis=0,
+    )                                            # [nq*rep, 1]
+    sc = jnp.where(t_idx <= pos_col, sc, NEG_INF)
+    probs = jax.nn.softmax(sc, axis=-1)
+    o = jnp.sum(
+        probs.astype(vg.dtype)[..., None] * vg[None, :, :], axis=-2
+    )                                            # [nq*rep, hd]
+    o_ref[0, :, 0] = o.reshape(nq, rep, -1)
+
+
+def paged_attend(q, k_pool, v_pool, tables, pos, *,
+                 interpret: bool = True):
+    """Fused block-table attention: ``q`` [S, Q, Hkv, rep, hd] against
+    the paged pools through ``tables`` [S, MB] with per-row position
+    masks ``pos`` [S, Q].  Returns [S, Q, Hkv, rep, hd] in the pool
+    dtype — bitwise-equal to the decoder's gather path for fp32."""
+    s, nq, hkv, rep, hd = q.shape
+    nb1, hkv_p, bs, hd_p = k_pool.shape
+    assert (hkv, hd) == (hkv_p, hd_p), (q.shape, k_pool.shape)
+    assert k_pool.shape == v_pool.shape
+    mb = tables.shape[1]
+    assert tables.shape == (s, mb) and pos.shape == (s, nq), (
+        tables.shape, pos.shape, q.shape
+    )
+    t_pad = mb * bs
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,                   # tables, pos
+        grid=(s, hkv),
+        in_specs=[
+            pl.BlockSpec(
+                (1, nq, 1, rep, hd), lambda i, j, *_: (i, 0, j, 0, 0)
+            ),
+            pl.BlockSpec(memory_space=pltpu.ANY),   # K pool stays HBM
+            pl.BlockSpec(memory_space=pltpu.ANY),   # V pool stays HBM
+        ],
+        out_specs=pl.BlockSpec(
+            (1, nq, 1, rep, hd), lambda i, j, *_: (i, 0, j, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((t_pad, hd), k_pool.dtype),
+            pltpu.VMEM((t_pad, hd), v_pool.dtype),
+            pltpu.SemaphoreType.DMA((mb,)),
+            pltpu.SemaphoreType.DMA((mb,)),
+        ],
+    )
+    kernel = functools.partial(
+        _paged_attend_kernel, mb=mb, bs=bs, nq=nq, scale=hd ** -0.5
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, v_pool.dtype),
+        interpret=interpret,
+    )(tables, pos, q, k_pool, v_pool)

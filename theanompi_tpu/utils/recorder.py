@@ -362,6 +362,8 @@ class ServingRecorder:
         tokens: int,
         blocks_in_use: int | None = None,
         blocks_free: int | None = None,
+        drafted: int | None = None,
+        accepted: int | None = None,
     ) -> None:
         self.steps.append({
             "active_slots": int(active_slots),
@@ -370,6 +372,11 @@ class ServingRecorder:
             "tokens": int(tokens),
             "blocks_in_use": blocks_in_use,
             "blocks_free": blocks_free,
+            # speculative decoding (serving v5): draft tokens offered
+            # to / reproduced by this verify step — None on the
+            # non-speculative path
+            "drafted": drafted,
+            "accepted": accepted,
         })
         self.record_block_gauges(
             blocks_in_use=blocks_in_use, blocks_free=blocks_free
@@ -466,6 +473,17 @@ class ServingRecorder:
         # prompt tokens, and the block gauges' extremes
         hit_tokens = sum(r.get("n_prefix_hit", 0) for r in ok)
         prompt_tokens = sum(r["n_prompt"] for r in ok)
+        # speculative decoding: accept-rate over offered drafts and
+        # tokens committed per SLOT-STEP (one slot, one decode/verify
+        # dispatch) — exactly 1.0 when speculation is off or every
+        # draft missed, > 1 when verify windows land; dividing by
+        # slot-steps rather than steps keeps batch width out of the
+        # speculation datum
+        drafted = sum(s.get("drafted") or 0 for s in self.steps)
+        accepted = sum(s.get("accepted") or 0 for s in self.steps)
+        slot_steps = sum(
+            s["active_slots"] for s in self.steps if s["tokens"] > 0
+        )
         return {
             "n_requests": len(self.requests),
             "n_completed": len(ok),
@@ -487,6 +505,12 @@ class ServingRecorder:
             ),
             "queue_depth_max": max(depths) if depths else None,
             "finish_reasons": finish_reasons,
+            "drafted_tokens": drafted,
+            "accepted_tokens": accepted,
+            "accept_rate": accepted / drafted if drafted else None,
+            "tokens_per_step": (
+                tokens / slot_steps if slot_steps else None
+            ),
             "prefix_hit_tokens": hit_tokens,
             "prefix_hit_rate": (
                 hit_tokens / prompt_tokens if prompt_tokens else None
@@ -646,7 +670,8 @@ class FleetRecorder:
                 k: s[k] for k in (
                     "tokens_per_sec", "slot_occupancy",
                     "prefix_hit_rate", "shed_reasons", "n_completed",
-                    "tokens_generated", "decode_s",
+                    "tokens_generated", "decode_s", "accept_rate",
+                    "tokens_per_step",
                 )
             }
             merged.merge(state)
@@ -655,6 +680,11 @@ class FleetRecorder:
         out["slot_occupancy"] = ms["slot_occupancy"]
         out["prefix_hit_rate"] = ms["prefix_hit_rate"]
         out["tokens_generated"] = ms["tokens_generated"]
+        # speculation telemetry survives the fleet merge: drafted/
+        # accepted sum across replicas, so the fleet accept-rate is
+        # the draft-weighted mean
+        out["accept_rate"] = ms["accept_rate"]
+        out["tokens_per_step"] = ms["tokens_per_step"]
         # concurrent replicas: aggregate rate is the sum of rates
         rates = [
             p["tokens_per_sec"] for p in per.values()

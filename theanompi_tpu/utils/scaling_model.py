@@ -603,11 +603,88 @@ def serving_roofline(
             out["contiguous_kv_bytes_per_slot"] = contig_slot
             out["paged_hbm_saving"] = contig_slot / paged_slot
             out["max_slots_contiguous"] = int(hbm_for_kv // contig_slot)
+        # fused paged-attention kernel arithmetic intensity
+        # (serving/paged_attention.py).  The jnp gather path
+        # materializes the padded [batch, Hkv, MB*bs, hd] window per
+        # layer: pool rows are read, written back as the gathered
+        # copy, and read again by the matmuls (~3x the PADDED
+        # window's bytes); the fused kernel moves each cached token's
+        # K/V once, at `context` tokens.  Intensity sits far below
+        # the chip's ridge — the kernel is bandwidth-bound by
+        # construction, so bytes saved convert directly into step
+        # time (`paged_attend_frac` in the serving_paged row is the
+        # measured check).
+        n_heads = int(cfg["n_heads"])
+        hd = int(cfg["dim"]) // n_heads
+        L = int(cfg["n_layers"])
+        t_padded = (
+            -(-int(max_seq if max_seq is not None else context)
+              // int(block_size)) * int(block_size)
+        )
+        attend_flops = 4.0 * L * batch * (n_heads / tp) * context * hd
+        bytes_fused = batch * kv_tok * context
+        bytes_gather = 3.0 * batch * kv_tok * t_padded
+        out["paged_attend_flops_per_step"] = attend_flops
+        out["paged_attend_bytes_fused"] = bytes_fused
+        out["paged_attend_bytes_gather"] = bytes_gather
+        out["paged_attend_intensity"] = attend_flops / bytes_fused
+        out["ridge_intensity"] = chip.peak_bf16 / chip.hbm_bw
+        out["paged_attend_hbm_speedup"] = bytes_gather / bytes_fused
     if prefix_hit_frac:
         assert 0.0 <= prefix_hit_frac < 1.0, prefix_hit_frac
         out["prefix_hit_frac"] = prefix_hit_frac
         out["prefix_ttft_speedup"] = 1.0 / (1.0 - prefix_hit_frac)
     return out
+
+
+def speculation_speedup(
+    *,
+    k: int,
+    accept_rate: float,
+    verify_cost_ratio: float = 1.0,
+    conditional: bool = False,
+) -> dict:
+    """Predicted win of speculative decoding at verify window ``k``
+    (1 committed token + ``k-1`` drafts per step,
+    ``Engine(speculate_k=k)``).
+
+    ``accept_rate`` defaults to the UNCONDITIONAL accepted/offered
+    ratio — exactly ``ServingRecorder.summary()['accept_rate']``
+    (accepted_tokens / drafted_tokens).  By linearity the expected
+    committed tokens per full-window step is then EXACTLY
+    ``E = 1 + a * (k - 1)`` (accepted prefix + the model's bonus
+    token) — no distributional assumption; the figure only
+    overestimates when the drafter offers short windows (fewer than
+    ``k-1`` drafts), which the measured ``tokens_per_step`` exposes.
+    ``conditional=True`` instead reads ``accept_rate`` as the
+    per-draft CONDITIONAL probability (draft ``i`` matters only if
+    drafts ``1..i-1`` matched — a drafter-quality model, not the
+    recorder datum): ``E = sum_{i=0}^{k-1} a^i = (1-a^k)/(1-a)``.
+    Do NOT feed the recorder's ratio to the conditional form — the
+    unconditional ratio is systematically lower and would
+    underpredict.
+
+    Decode is HBM-bound, so a verify step costs ~one decode step
+    (same weight read, same KV history read; the k-row activations
+    are noise) — ``verify_cost_ratio`` prices any measured
+    deviation.  Speedup = ``E / verify_cost_ratio``; at ``a = 0``
+    both forms degrade to exactly 1.0 (one token per step), the
+    engine's tested floor.
+    """
+    assert k >= 1 and 0.0 <= accept_rate <= 1.0, (k, accept_rate)
+    a = float(accept_rate)
+    if conditional:
+        expected = float(k) if a >= 1.0 else (1.0 - a ** k) / (1.0 - a)
+    else:
+        expected = 1.0 + a * (k - 1)
+    return {
+        "k": int(k),
+        "accept_rate": a,
+        "conditional": bool(conditional),
+        "tokens_per_step": expected,
+        "verify_cost_ratio": float(verify_cost_ratio),
+        "speedup": expected / float(verify_cost_ratio),
+    }
 
 
 def fleet_roofline(
