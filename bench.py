@@ -1413,6 +1413,65 @@ out["spec_decode"] = {
         k=SPEC_K, accept_rate=spec_sum["accept_rate"]),
 }
 
+# --- traced-vs-untraced A/B (obs span tracing, ISSUE 14) ------------
+# the same closed-loop workload with the span flight-recorder ON at
+# the DEFAULT 1/N rate vs OFF, interleaved repeats, medians: the
+# host-stamp-only discipline must cost < 2% wall.  A sample=1 pass
+# first proves the invariants: one connected tree per request, root
+# count conserved, Perfetto export parses.
+import statistics
+from theanompi_tpu.obs import (
+    DEFAULT_TRACE_SAMPLE, Tracer, chrome_trace, span_tree)
+
+trace_prompts = distinct_prompts(4 if smoke else 16)
+def run_traced(tracer):
+    eng = Engine(dec_pg, recorder=ServingRecorder(dec_pg.max_slots),
+                 prefix_caching=False, tracer=tracer)
+    t0 = time.perf_counter()
+    futs = [eng.submit(p, max_tokens=max_tokens, seed=i)
+            for i, p in enumerate(trace_prompts)]
+    eng.run_until_idle()
+    wall = time.perf_counter() - t0
+    rs = [f.result(timeout=0) for f in futs]
+    assert all(r.status == "ok" for r in rs), rs
+    return wall, rs
+
+tr1 = Tracer(process="bench", sample=1)
+_, rs1 = run_traced(tr1)
+roots = [s for s in tr1.spans() if s["parent_id"] is None]
+# span-count conservation: exactly one root span per completed
+# request, and every request's flight record is ONE connected tree
+assert len(roots) == len(trace_prompts), (len(roots), trace_prompts)
+for r in rs1:
+    tid = {s["trace_id"] for s in r.spans}.pop()
+    rep = span_tree(r.spans, tid)
+    assert rep["connected"], rep
+json.dumps(chrome_trace(tr1.spans()))   # the export parses
+
+walls_off, walls_on = [], []
+for _ in range(3 if smoke else 5):
+    w_off, _ = run_traced(None)
+    w_on, _ = run_traced(
+        Tracer(process="bench", sample=DEFAULT_TRACE_SAMPLE))
+    walls_off.append(w_off)
+    walls_on.append(w_on)
+overhead = statistics.median(walls_on) / statistics.median(walls_off)
+# smoke arms are ~100 ms of wall — scheduler noise alone exceeds 2%
+# there, so the smoke bound is proportionally looser; the FULL arm
+# (the BENCH_r08 datum) holds the 2% acceptance bar
+bound = 1.10 if smoke else 1.02
+assert overhead < bound, (walls_on, walls_off)
+out["tracing"] = {
+    "trace_sample": DEFAULT_TRACE_SAMPLE,
+    "overhead_bound": bound,
+    "traced_wall_s": statistics.median(walls_on),
+    "untraced_wall_s": statistics.median(walls_off),
+    "overhead_ratio": overhead,
+    "n_root_spans": len(roots),
+    "n_requests": len(trace_prompts),
+    "spans_per_request_sampled": len(tr1.spans()) / len(trace_prompts),
+}
+
 # one-compile discipline survives the whole sweep (decode + verify)
 out["n_decode_compiles"] = dec_pg.n_decode_compiles
 out["n_prefill_compiles"] = dec_pg.n_prefill_compiles
@@ -1613,6 +1672,11 @@ def bench_serving_paged() -> dict:
     # speculation data, `predicted` the HBM-bound hardware win
     if "spec_decode" in rec:
         result["spec_decode"] = round_tree(rec["spec_decode"])
+    # span-tracing A/B (ISSUE 14): flight-recorder ON at the default
+    # 1/N rate vs OFF — the <2% overhead bound and the span-count
+    # conservation/connectivity invariants are asserted IN-CHILD
+    if "tracing" in rec:
+        result["tracing"] = round_tree(rec["tracing"])
     # fused Pallas kernel A/B: token-exact vs the gather oracle with
     # paged_attend_frac attributed before (gather) / after (pallas)
     if "paged_attend_impl_ab" in rec:

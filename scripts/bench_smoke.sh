@@ -20,7 +20,11 @@
 #    v5 SPECULATIVE arm rides the same child: the same prompts served
 #    non-speculative then with speculate_k=4 must be BITWISE equal,
 #    with accept_rate > 0, tokens/slot-step > 1, and <= 2 decode
-#    compiles (decode + verify share the budget).
+#    compiles (decode + verify share the budget).  The TRACING arm
+#    (ISSUE 14) rides it too: a sample=1 pass asserts one connected
+#    span tree per request + root-span-count conservation + the
+#    Perfetto export parses, then traced-vs-untraced interleaved
+#    repeats assert < 2% wall overhead at the default 1/N rate.
 # 4. serving_fleet: the fleet router in smoke shape — 2 replica
 #    PROCESSES behind the TCP wire, one carrying a
 #    TM_FAULT_AT=1:4:die_replica drill that kills it mid-generation;
@@ -103,6 +107,19 @@ if not (sd.get("accept_rate") or 0) > 0:
 if not (sd.get("tokens_per_step") or 0) > 1:
     sys.exit("bench_smoke: speculative arm stayed at one "
              "token/step: %s" % sd)
+tr = row.get("tracing") or {}
+print("tracing overhead", tr.get("overhead_ratio"),
+      "root spans", tr.get("n_root_spans"), "/", tr.get("n_requests"))
+if not tr:
+    sys.exit("bench_smoke: serving_paged child carried no tracing "
+             "A/B: %s" % sorted(row))
+if tr["n_root_spans"] != tr["n_requests"]:
+    sys.exit("bench_smoke: span-count conservation off — %s root "
+             "spans for %s requests"
+             % (tr["n_root_spans"], tr["n_requests"]))
+if not tr["overhead_ratio"] < tr["overhead_bound"]:
+    sys.exit("bench_smoke: traced arm overhead %s past the %s bound"
+             % (tr["overhead_ratio"], tr["overhead_bound"]))
 print("bench_smoke: serving_paged OK")
 '
 

@@ -287,3 +287,76 @@ class TestSpeculativeVerifyFences:
                     return out
         """)
         assert out == []
+
+
+# -- tracer API in hot loops (PR 14: obs/tracer.py seeds) --------------------
+
+
+class TestTracerSpans:
+    """`Tracer.span`/`start_span`/`end_span`/`record_span` are
+    hot-name seeds: span bodies must stay host-pure, and a device
+    value fenced into a span attribute at a hot call site is the
+    per-iteration round trip TM104 exists for."""
+
+    def test_fence_inside_span_attr_in_hot_loop_flagged(self):
+        # the known-bad twin: per-slot decode loop reads a device
+        # value back just to decorate a span
+        out = run("""
+            class Eng:
+                def _spec_decode_once(self):
+                    for slot in range(8):
+                        out = jnp.argmax(self.logits[slot])
+                        with self.tracer.span(
+                            self.ctx, "spec_window", tokens=int(out)
+                        ):
+                            self.commit(slot)
+        """)
+        assert "TM104" in rules_of(out)
+        assert any("int() fence" in f.message for f in out)
+
+    def test_host_stamp_only_span_clean(self):
+        # the clean twin: same loop, same span, attrs are host ints
+        out = run("""
+            class Eng:
+                def _spec_decode_once(self):
+                    for slot in range(8):
+                        with self.tracer.span(
+                            self.ctx, "spec_window",
+                            tokens=self._step_tokens,
+                        ):
+                            self.commit(slot)
+        """)
+        assert out == []
+
+    def test_span_entry_exit_body_is_hot(self):
+        # the API bodies themselves are seeded hot: a tracer
+        # implementation that fences a device value on span entry/
+        # exit is flagged without any caller involved
+        out = run("""
+            class Tracer:
+                def span(self, ctx, name, value):
+                    t0 = self.clock()
+                    snapshot = value.item()
+                    return (t0, snapshot)
+        """)
+        assert rules_of(out) == ["TM104"]
+        assert ".item()" in out[0].message
+
+    def test_host_pure_span_body_clean(self):
+        # the real tracer's shape: monotonic stamps + dict ops only
+        out = run("""
+            class Tracer:
+                def start_span(self, ctx, name, **attrs):
+                    if ctx is None:
+                        return None
+                    return {"name": name, "t0": self.clock(),
+                            "attrs": dict(attrs)}
+
+                def end_span(self, handle, **attrs):
+                    if handle is None:
+                        return None
+                    handle["attrs"].update(attrs)
+                    handle["t1"] = self.clock()
+                    return handle
+        """)
+        assert out == []

@@ -366,3 +366,43 @@ class TestHeldLockSideEffects:
                     entry.future._set(None)  # tmcheck: disable=TM103
         """)
         assert out == []
+
+
+# -- TM103: trace exports under a held lock (PR 14) --------------------------
+
+
+class TestTraceExportUnderLock:
+    def test_chrome_trace_under_lock_flagged(self):
+        out = run("""
+            class Router:
+                def dump(self):
+                    with self._lock:
+                        return chrome_trace(self._spans)
+        """)
+        assert "TM103" in rules_of(out)
+        assert any("trace-export" in f.rule or "span ring" in f.message
+                   for f in out)
+
+    def test_collect_spans_method_under_lock_flagged(self):
+        # the wire-pulling variant: replicas answer over TCP — doing
+        # that while holding the router lock parks the fleet
+        out = run("""
+            class Router:
+                def dump(self):
+                    with self._lock:
+                        return self.router.collect_spans()
+        """)
+        assert "TM103" in rules_of(out)
+
+    def test_export_outside_lock_clean(self):
+        # the real router's shape: snapshot membership under the
+        # lock, pull and serialize outside it
+        out = run("""
+            class Router:
+                def dump(self):
+                    with self._lock:
+                        members = list(self._members)
+                    spans = self.router.collect_spans()
+                    return chrome_trace(spans)
+        """)
+        assert [f for f in out if f.rule == "TM103"] == []

@@ -150,14 +150,22 @@ def _deny_op(sf: SourceFile, call: ast.Call) -> tuple[str, str] | None:
     """Classify a call as a TM103 deny-list op -> (op id, detail)."""
     f = call.func
     kwnames = {k.arg for k in call.keywords}
+    _TRACE_EXPORT = ("chrome_trace", "write_chrome_trace",
+                     "critical_path", "collect_spans")
     if isinstance(f, ast.Name):
         if f.id == "send_frame" and "timeout_s" not in kwnames:
             return ("unbounded-send",
                     "send_frame(...) without timeout_s")
+        if f.id in _TRACE_EXPORT:
+            return ("trace-export",
+                    f"{f.id}(...) exports a span ring under a lock")
         return None
     if not isinstance(f, ast.Attribute):
         return None
     recv = sf.src(f.value).lower()
+    if f.attr in _TRACE_EXPORT:
+        return ("trace-export",
+                f".{f.attr}(...) exports a span ring under a lock")
     if f.attr == "_set":
         return ("future-resolve", f"{sf.src(f)}() resolves a future")
     if f.attr == "add_done_callback":

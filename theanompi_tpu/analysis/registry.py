@@ -48,9 +48,15 @@ GUARDED_BY: dict[str, tuple[str | None, frozenset]] = {
     "Supervisor": (None, frozenset()),
 }
 
-#: hot-path seeds: exact function names …
+#: hot-path seeds: exact function names …  The tracer API
+#: (obs/tracer.py span/start_span/end_span/record_span) is seeded
+#: because spans are recorded INSIDE the decode/prefill loops: their
+#: bodies must stay host-pure, and a device value fenced into a span
+#: attribute at a call site in a hot function is the same
+#: per-iteration round trip TM104 exists for (fixture-tested).
 HOT_EXACT = frozenset({
     "step", "decode", "decode_step", "prefill", "verify", "draft",
+    "span", "start_span", "end_span", "record_span",
 })
 #: … and substrings (catches `_advance_prefill_slot`,
 #: `_prepare_decode_writes`, `_spec_decode_once`, `_verify_body` and
@@ -79,6 +85,10 @@ DENY_UNDER_LOCK = {
     "blocking-wait": "blocking `.result()`/queue `.get()`/thread "
                      "`.join()` parks the lock holder",
     "sleep": "`time.sleep(...)` holds the lock across a stall",
+    "trace-export": "`chrome_trace(...)`/`critical_path(...)`/"
+                    "`collect_spans(...)` serializes/pulls a whole "
+                    "span ring (possibly over the wire) while "
+                    "holding a lock",
 }
 
 #: receiver-name hints -> class-name keywords, for resolving

@@ -1,0 +1,37 @@
+"""Observability subsystem: distributed span tracing (bounded
+flight-recorder, Perfetto export, critical-path attribution) and
+Prometheus-style metrics text.  See docs/OBSERVABILITY.md."""
+
+from theanompi_tpu.obs.tracer import (  # noqa: F401
+    DEFAULT_TRACE_SAMPLE,
+    Tracer,
+    child_context,
+    force_sample,
+    make_context,
+)
+from theanompi_tpu.obs.export import (  # noqa: F401
+    chrome_trace,
+    critical_path,
+    format_critical_path,
+    span_tree,
+    write_chrome_trace,
+)
+from theanompi_tpu.obs.metrics import (  # noqa: F401
+    quantile_samples,
+    render_metrics,
+)
+
+__all__ = [
+    "DEFAULT_TRACE_SAMPLE",
+    "Tracer",
+    "child_context",
+    "chrome_trace",
+    "critical_path",
+    "force_sample",
+    "format_critical_path",
+    "make_context",
+    "quantile_samples",
+    "render_metrics",
+    "span_tree",
+    "write_chrome_trace",
+]

@@ -95,6 +95,7 @@ class Autoscaler:
         index: int = 0,
         manage=None,
         verbose: bool = False,
+        tracer=None,
     ):
         if not 0 <= scale_down_at < scale_up_at:
             raise ValueError(
@@ -135,6 +136,13 @@ class Autoscaler:
         self.default_slots = int(default_slots)
         self.index = int(index)
         self.verbose = bool(verbose)
+        # span tracing (obs/tracer.py): scale actions are rare and
+        # load-bearing, so each is its OWN always-sampled trace —
+        # scale_up covers decision → modeled readiness, scale_down
+        # covers drain → retire.  Defaults to the router's tracer so
+        # control-plane lanes land in the same Perfetto export.
+        self.tracer = tracer if tracer is not None \
+            else getattr(router, "tracer", None)
 
         self.managed: set[str] = (
             set(str(n) for n in manage) if manage is not None
@@ -189,6 +197,10 @@ class Autoscaler:
     def _scale_up(self, now: float, why: str) -> bool:
         if len(self._managed_alive()) >= self.max_replicas:
             return False
+        # decision stamp in the TRACER's clock domain (it may not be
+        # time.monotonic — deterministic-test tracers pass clock=)
+        t_dec = self.tracer.clock() if self.tracer is not None \
+            else 0.0
         replica = self.spawn(self._spawn_idx)
         spawn_s = max(
             self.spawn_latency_s, time.monotonic() - now
@@ -209,6 +221,15 @@ class Autoscaler:
         # second spawn the first one was already bought to relieve
         self._last_action_t = now + spawn_s
         self._above_since = self._below_since = None
+        if self.tracer is not None:
+            # decision → modeled readiness (the cold-start window
+            # the ledger bills); lane "autoscaler" in the export
+            self.tracer.record_span(
+                self.tracer.new_context(force=True), "scale_up",
+                t_dec, t_dec + spawn_s,
+                lane="autoscaler", replica=name, reason=why,
+                spawn_s=spawn_s,
+            )
         self._say(f"scale-up -> {name} ({why}, spawn {spawn_s:.2f}s)")
         return True
 
@@ -224,8 +245,17 @@ class Autoscaler:
             return False
         victim = min(candidates, key=lambda n: (loads[n], n))
         replica = self.router.replica_named(victim)
+        t0 = self.tracer.clock() if self.tracer is not None else 0.0
         n_moved = self.router.drain_replica(victim)
         self.router.remove_replica(victim)
+        if self.tracer is not None:
+            # drain → retire, with the uncharged-requeue count — the
+            # "why did these requests move" answer in the export
+            self.tracer.record_span(
+                self.tracer.new_context(force=True), "scale_down",
+                t0, self.tracer.clock(), lane="autoscaler",
+                replica=victim, reason=why, n_requeued=n_moved,
+            )
         self.router.recorder.record_retire(victim, reason=why)
         self.managed.discard(victim)
         self.events.append({
@@ -331,3 +361,24 @@ class Autoscaler:
             ),
             "events": list(self.events),
         }
+
+    def metrics_txt(self, prefix: str = "tm_autoscaler") -> str:
+        """Prometheus-style text for the control plane (stable
+        names; ride it next to the router's fleet dump)."""
+        from theanompi_tpu.obs.metrics import render_metrics
+
+        s = self.summary()
+        p = prefix
+        return render_metrics([
+            (f"{p}_ticks_total", "counter", [(None, s["n_ticks"])]),
+            (f"{p}_scale_ups_total", "counter",
+             [(None, s["n_scale_ups"])]),
+            (f"{p}_scale_downs_total", "counter",
+             [(None, s["n_scale_downs"])]),
+            (f"{p}_pressure", "gauge", [(None, s["last_pressure"])]),
+            (f"{p}_managed_replicas", "gauge",
+             [(None, len(s["managed"]))]),
+            (f"{p}_spawn_latency_charged_seconds", "counter",
+             [(None, s["spawn_latency_charged_s"])]),
+            (f"{p}_dead", "gauge", [(None, s["dead"])]),
+        ])

@@ -53,6 +53,7 @@ import numpy as np
 
 import jax
 
+from theanompi_tpu.obs.tracer import Tracer, force_sample
 from theanompi_tpu.serving.blocks import OutOfBlocks
 from theanompi_tpu.serving.decoder import LlamaDecoder
 from theanompi_tpu.utils.recorder import ServingRecorder
@@ -80,6 +81,12 @@ class Request:
     seed: int = 0                    # per-request PRNG key seed
     prefill_only: bool = False
     handoff: dict | None = None
+    # span context (obs/tracer.py): {"trace_id", "parent_id",
+    # "sampled"} — the router stamps it per dispatch so a request's
+    # engine-side spans parent under THAT dispatch hop; it rides the
+    # TCP submit frames unchanged.  None = the engine roots its own
+    # trace (when it has a tracer at all).
+    trace: dict | None = None
 
 
 @dataclass
@@ -105,6 +112,11 @@ class Result:
     # disaggregation: a "prefilled" result carries the KV handoff
     # record (serving/kv_transfer.py) for the decode-phase dispatch
     handoff: dict | None = None
+    # flight record (obs/tracer.py): this request's spans from THE
+    # REPLICA THAT SERVED IT ride the result back to the router,
+    # which ingests them — the span tree survives the replica's
+    # death the moment the result is delivered
+    spans: list = field(default_factory=list)
 
 
 class ServingFuture:
@@ -148,7 +160,8 @@ class ServingFuture:
 
 
 class _Entry:
-    __slots__ = ("request", "future", "submit_t", "deadline_s")
+    __slots__ = ("request", "future", "submit_t", "deadline_s",
+                 "ctx", "root", "qspan")
 
     def __init__(self, request: Request, default_deadline_s: float):
         self.request = request
@@ -160,12 +173,18 @@ class _Entry:
             request.deadline_s if request.deadline_s is not None
             else default_deadline_s
         )
+        # tracing state (set by Engine._trace_submit when a tracer is
+        # attached): span context, engine-rooted root span handle
+        # (None when the router owns the root), open queue-wait span
+        self.ctx: dict | None = None
+        self.root: dict | None = None
+        self.qspan: dict | None = None
 
 
 class _SlotState:
     __slots__ = (
         "entry", "generated", "first_tok_t", "last_tok_t", "prompt_len",
-        "state", "pf_pos", "n_prefix_hit",
+        "state", "pf_pos", "n_prefix_hit", "pf_span", "dec_span",
     )
 
     def __init__(self, entry: _Entry, prompt_len: int,
@@ -182,6 +201,9 @@ class _SlotState:
         self.state = state
         self.pf_pos = pf_pos
         self.n_prefix_hit = n_prefix_hit
+        # open span handles (tracing): prefill leg / decode leg
+        self.pf_span: dict | None = None
+        self.dec_span: dict | None = None
 
 
 class Engine:
@@ -200,6 +222,8 @@ class Engine:
         prefix_caching: bool = True,
         speculate_k: int = 0,
         drafter=None,
+        tracer: Tracer | None = None,
+        trace_sample: int = 0,
     ):
         self.decoder = decoder
         self.queue_cap = int(queue_cap)
@@ -277,6 +301,84 @@ class Engine:
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
 
+        # span tracing (obs/tracer.py): host-stamp-only spans per
+        # sampled request — queue wait, per-chunk prefill, decode,
+        # spec-decode windows, CoW/grow, evictions.  Off (None) by
+        # default: zero overhead.  A request's spans ride its Result
+        # (the flight record the router stitches fleet-wide).
+        if tracer is None and int(trace_sample) > 0:
+            tracer = Tracer(process="engine", sample=int(trace_sample))
+        self._tracer = tracer
+
+    @property
+    def tracer(self) -> Tracer | None:
+        return self._tracer
+
+    # -- tracing hooks (host stamps only — no device reads) ---------------
+
+    def _trace_submit(self, entry: _Entry) -> None:
+        tr = self._tracer
+        if tr is None:
+            return
+        req = entry.request
+        if req.trace is not None:
+            # the router (or another dispatcher) owns the root: our
+            # spans parent under ITS dispatch span
+            ctx = req.trace
+        elif req.handoff is not None and isinstance(
+                req.handoff.get("trace"), dict):
+            # router-less disaggregation: the handoff record carries
+            # the prefill side's context, so the decode leg still
+            # joins the same tree
+            ctx = dict(req.handoff["trace"])
+        else:
+            ctx = tr.new_context()
+            entry.root = tr.start_span(
+                ctx, "request", n_prompt=len(req.prompt)
+            )
+        entry.ctx = ctx
+        entry.qspan = tr.start_span(
+            ctx, "engine_queue", parent_id=self._parent_of(entry),
+            n_prompt=len(req.prompt),
+        )
+
+    def _parent_of(self, entry: _Entry) -> int | None:
+        if entry.root is not None:
+            return entry.root["span_id"]
+        return entry.ctx.get("parent_id") if entry.ctx else None
+
+    def _slot_ctx(self, slot: int) -> dict | None:
+        st = self._slots[slot]
+        return st.entry.ctx if st is not None else None
+
+    def _slot_parent(self, slot: int) -> int | None:
+        """The slot's innermost open span id — what block-machinery
+        spans (CoW, grow, evict) parent under so every span stays on
+        one connected tree."""
+        st = self._slots[slot]
+        if st is None:
+            return None
+        h = st.pf_span if st.state == "prefill" else st.dec_span
+        if h is not None:
+            return h["span_id"]
+        return self._parent_of(st.entry)
+
+    def _trace_shed(self, entry: _Entry, reason: str) -> list:
+        """Close a shed request's open spans — FORCE-sampled (a shed
+        is exactly the tail the 1/N rate must not lose) — and return
+        its flight record for the Result."""
+        tr = self._tracer
+        if tr is None or entry.ctx is None:
+            return []
+        force_sample(entry.ctx)
+        tr.end_span(entry.qspan, reason=reason)
+        entry.qspan = None
+        if entry.root is not None:
+            tr.end_span(entry.root, status="shed",
+                        finish_reason=reason)
+            entry.root = None
+        return tr.spans(entry.ctx["trace_id"])
+
     # -- submission (any thread) ------------------------------------------
 
     def submit(self, prompt, **kw) -> ServingFuture:
@@ -295,6 +397,7 @@ class Engine:
         else:
             req = Request(prompt=list(prompt), **kw)
         entry = _Entry(req, self.default_deadline_s)
+        self._trace_submit(entry)
         # servability check up front (admission, not an exception the
         # engine loop would have to route back)
         try:
@@ -332,6 +435,7 @@ class Engine:
         future resolves immediately; queued time is zero)."""
         entry.future._set(Result(
             status="shed", finish_reason=reason, queued_s=0.0,
+            spans=self._trace_shed(entry, reason),
         ))
         self.recorder.record_request(
             status="shed", finish_reason=reason,
@@ -352,6 +456,7 @@ class Engine:
         entry.future._set(Result(
             status="shed", finish_reason=reason,
             queued_s=now - entry.submit_t,
+            spans=self._trace_shed(entry, reason),
         ))
         self.recorder.record_request(
             status="shed", finish_reason=reason,
@@ -397,12 +502,26 @@ class Engine:
         )
         e2e = st.last_tok_t - st.entry.submit_t
         ttft = st.first_tok_t - st.entry.submit_t
+        spans: list = []
+        tr = self._tracer
+        ent = st.entry
+        if tr is not None and ent.ctx is not None:
+            tr.end_span(st.dec_span, tokens=n, finish_reason=reason)
+            st.dec_span = None
+            if ent.root is not None:
+                tr.end_span(ent.root, status="ok",
+                            finish_reason=reason)
+                ent.root = None
+            # the flight record: every span this engine kept for the
+            # trace rides the result to whoever dispatched it
+            spans = tr.spans(ent.ctx["trace_id"])
         res = Result(
             status="ok", finish_reason=reason,
             tokens=list(st.generated),
             ttft_s=ttft, tpot_s=tpot,
             queued_s=None, e2e_s=e2e,
             handoff=handoff,
+            spans=spans,
         )
         st.entry.future._set(res)
         self.recorder.record_request(
@@ -414,15 +533,24 @@ class Engine:
 
     # -- paged-cache admission / prefill (serving v2) ----------------------
 
-    def _try_blocks(self, n_needed: int) -> bool:
+    def _try_blocks(self, n_needed: int, ctx: dict | None = None,
+                    parent_id: int | None = None) -> bool:
         """Free-list headroom for ``n_needed`` fresh blocks, evicting
         LRU prefix-cache leaves when short.  Host-side only — no
-        allocation happens here."""
+        allocation happens here.  ``ctx``/``parent_id`` attribute the
+        eviction span to the request that forced it."""
         alloc = self._mgr.allocator
         if alloc.blocks_free >= n_needed:
             return True
         if self._evictable is not None:
-            self._evictable.evict(n_needed - alloc.blocks_free)
+            short = n_needed - alloc.blocks_free
+            if self._tracer is not None and ctx is not None:
+                with self._tracer.span(ctx, "cache_evict",
+                                       parent_id=parent_id,
+                                       n_requested=short):
+                    self._evictable.evict(short)
+            else:
+                self._evictable.evict(short)
         return alloc.blocks_free >= n_needed
 
     def _admit_handoff(self, slot: int, entry: _Entry,
@@ -459,7 +587,8 @@ class Engine:
         # let the first grow() hit a dry pool and silently truncate
         # an "ok" result to one token
         n_total = max(n_blk, self._mgr.blocks_for(plen + 1))
-        if not self._try_blocks(n_total):
+        if not self._try_blocks(n_total, entry.ctx,
+                                self._parent_of(entry)):
             if not any(s is not None for s in self._slots):
                 # nothing in flight will ever free a block — let the
                 # router retry the full prompt on a roomier member
@@ -468,10 +597,23 @@ class Engine:
             with self._lock:
                 self._queue.appendleft(entry)   # keep FIFO order
             return False
+        tr = self._tracer
+        if tr is not None:
+            tr.end_span(entry.qspan)
+            entry.qspan = None
+        t0 = tr.clock() if tr is not None else 0.0
         self._mgr.assign(slot, [], n_total)
         kv_transfer.inject_handoff(self.decoder, self._mgr, slot, h)
         first = int(h["first_token"])
         self._slots[slot] = _SlotState(entry, plen, first)
+        if tr is not None and entry.ctx is not None:
+            tr.record_span(
+                entry.ctx, "handoff_import", t0, tr.clock(),
+                parent_id=self._parent_of(entry), n_blocks=n_blk,
+            )
+            self._slots[slot].dec_span = tr.start_span(
+                entry.ctx, "decode", parent_id=self._parent_of(entry),
+            )
         self._tokens[slot] = first
         self._lengths[slot] = plen
         self._keys[slot] = np.asarray(
@@ -508,7 +650,8 @@ class Engine:
                 if self._prefix is not None else (0, [])
             )
             n_total = self._mgr.blocks_for(plen + 1)
-            if not self._try_blocks(n_total - len(adopted)):
+            if not self._try_blocks(n_total - len(adopted), entry.ctx,
+                                    self._parent_of(entry)):
                 self._mgr.release_adopted(adopted)
                 if self._prefix is not None:
                     # abandoned adoption: hit-rate counters must only
@@ -527,6 +670,14 @@ class Engine:
                 entry, plen, state="prefill", pf_pos=matched,
                 n_prefix_hit=matched,
             )
+            if self._tracer is not None:
+                self._tracer.end_span(entry.qspan)
+                entry.qspan = None
+                self._slots[slot].pf_span = self._tracer.start_span(
+                    entry.ctx, "prefill",
+                    parent_id=self._parent_of(entry),
+                    n_prompt=plen, matched=matched,
+                )
             self._keys[slot] = np.asarray(
                 jax.random.PRNGKey(req.seed), np.uint32
             )
@@ -541,9 +692,23 @@ class Engine:
         block being written), so give the allocator LRU-evicted room
         first.  Raises ``OutOfBlocks`` when the pool is truly dry."""
         bid = int(self._mgr.tables[slot, bidx])
-        if self._mgr.allocator.refcount(bid) > 1:
-            self._try_blocks(1)
-        self._mgr.ensure_writable(slot, bidx, self.decoder.copy_block)
+        shared = self._mgr.allocator.refcount(bid) > 1
+        ctx = self._slot_ctx(slot)
+        parent = self._slot_parent(slot)
+        if shared:
+            self._try_blocks(1, ctx, parent)
+        if shared and self._tracer is not None and ctx is not None:
+            # only the copy-on-write case gets a span: the unshared
+            # fast path is a host no-op not worth ring space
+            with self._tracer.span(ctx, "kv_cow", parent_id=parent,
+                                   block=bid):
+                self._mgr.ensure_writable(
+                    slot, bidx, self.decoder.copy_block
+                )
+        else:
+            self._mgr.ensure_writable(
+                slot, bidx, self.decoder.copy_block
+            )
 
     def _abort_prefill(self, slot: int, reason: str) -> None:
         """A mid-prefill slot cannot deliver tokens: resolve its
@@ -551,6 +716,10 @@ class Engine:
         st = self._slots[slot]
         self._slots[slot] = None
         self._mgr.free_slot(slot)
+        if self._tracer is not None:
+            self._tracer.end_span(st.pf_span, force=True,
+                                  reason=reason)
+            st.pf_span = None
         self._shed(st.entry, reason, time.monotonic())
 
     def _advance_prefill_slot(self, slot: int,
@@ -563,12 +732,15 @@ class Engine:
         req = st.entry.request
         dec = self.decoder
         bs = dec.block_size
+        tr = self._tracer
+        ctx = st.entry.ctx
         done = 0
         tok = None
         while st.pf_pos < st.prompt_len and (
             limit is None or done < limit
         ):
             c = min(dec.prefill_chunk, st.prompt_len - st.pf_pos)
+            t0c = tr.clock() if tr is not None else 0.0
             try:
                 for bidx in range(
                     st.pf_pos // bs, (st.pf_pos + c - 1) // bs + 1
@@ -582,6 +754,18 @@ class Engine:
                 req.prompt[st.pf_pos: st.pf_pos + c],
                 st.pf_pos, c, self._keys[slot], req.temperature,
             )
+            if tr is not None and ctx is not None:
+                # host-dispatch stamps only: non-final chunk tokens
+                # stay un-read device arrays (the async pipeline the
+                # TM104 postmortem bought), so a chunk span measures
+                # dispatch time; the enclosing prefill span's end is
+                # the honest first-token fence
+                tr.record_span(
+                    ctx, "prefill_chunk", t0c, tr.clock(),
+                    parent_id=(st.pf_span["span_id"]
+                               if st.pf_span else None),
+                    pos=st.pf_pos, n_tokens=c,
+                )
             st.pf_pos += c
             done += 1
         if st.pf_pos >= st.prompt_len:
@@ -605,6 +789,11 @@ class Engine:
         st.generated = [first]
         st.first_tok_t = now
         st.last_tok_t = now
+        if self._tracer is not None:
+            # ends AT the fence: the prefill span covers admission →
+            # first real token, the wall-honest TTFT leg
+            self._tracer.end_span(st.pf_span, n_prompt=st.prompt_len)
+            st.pf_span = None
         # the partial tail block is cached too: its extra reference
         # forces ONE CoW block copy when this slot's decode writes
         # into it — the bounded price of partial-prefix adoption
@@ -635,10 +824,26 @@ class Engine:
             # normally above: nothing left to decode, no handoff.)
             from theanompi_tpu.serving import kv_transfer
 
+            from theanompi_tpu.obs.tracer import child_context
+
+            ctx = st.entry.ctx
+            parent = self._parent_of(st.entry) if ctx is not None \
+                else None
             h = kv_transfer.build_handoff(
-                self.decoder, self._mgr, slot, st.prompt_len, first
+                self.decoder, self._mgr, slot, st.prompt_len, first,
+                # re-parented under THIS request's root/dispatch span
+                # so a router-less receiver's decode-leg spans hang
+                # off the prefill tree instead of floating rootless
+                trace=(child_context(ctx, parent)
+                       if parent is not None
+                       else dict(ctx) if ctx is not None else None),
             )
             self._finish(slot, "prefilled", handoff=h)
+        elif self._tracer is not None and st.entry.ctx is not None:
+            st.dec_span = self._tracer.start_span(
+                st.entry.ctx, "decode",
+                parent_id=self._parent_of(st.entry),
+            )
 
     def _prepare_decode_writes(self) -> None:
         """Before each paged decode step: grow every decoding slot's
@@ -647,6 +852,7 @@ class Engine:
         that request loudly (``no_blocks``) with the tokens it has."""
         dec = self.decoder
         bs = dec.block_size
+        tr = self._tracer
         for slot, st in enumerate(self._slots):
             if st is None or st.state != "decode":
                 continue
@@ -654,7 +860,15 @@ class Engine:
             try:
                 need = bidx + 1 - self._mgr.n_owned[slot]
                 if need > 0:
-                    self._try_blocks(need)   # best-effort LRU evict
+                    ctx = st.entry.ctx
+                    parent = (st.dec_span["span_id"]
+                              if st.dec_span else None)
+                    self._try_blocks(need, ctx, parent)
+                    if tr is not None and ctx is not None:
+                        tr.record_span(
+                            ctx, "kv_grow", tr.clock(), tr.clock(),
+                            parent_id=parent, n_blocks=need,
+                        )
                 # grow/CoW allocate through the allocator, which
                 # counts the OOM and raises with its state attached
                 self._mgr.grow(slot, bidx)
@@ -716,7 +930,11 @@ class Engine:
                     last_bidx = (pos + n - 1) // bs
                     need = last_bidx + 1 - self._mgr.n_owned[slot]
                     if need > 0:
-                        self._try_blocks(need)   # best-effort evict
+                        self._try_blocks(
+                            need, st.entry.ctx,
+                            st.dec_span["span_id"]
+                            if st.dec_span else None,
+                        )
                     self._mgr.grow(slot, last_bidx)
                     for bidx in range(pos // bs, last_bidx + 1):
                         self._cow_gate(slot, bidx)
@@ -746,6 +964,8 @@ class Engine:
         self._prepare_spec_decode_writes()
         if not self._decoding_slots():
             return 0
+        tr = self._tracer
+        t_v0 = tr.clock() if tr is not None else 0.0
         out = self.decoder.verify(
             self._draft, self._lengths, self._keys, self._temps,
             self._mgr.tables, self._n_valid,
@@ -765,6 +985,18 @@ class Engine:
             while a < kv - 1 and row[a] == self._draft[slot, a + 1]:
                 a += 1
             self._step_drafted += kv - 1
+            if tr is not None and st.entry.ctx is not None:
+                # recorded BEFORE the emit loop so a mid-window
+                # finish still carries this window in its flight
+                # record; `a` is the accepted-draft count (the emit
+                # loop may cut earlier on EOS — the recorder's
+                # step counters keep the emitted truth)
+                tr.record_span(
+                    st.entry.ctx, "spec_window", t_v0, tr.clock(),
+                    parent_id=(st.dec_span["span_id"]
+                               if st.dec_span else None),
+                    drafted=kv - 1, accepted=a,
+                )
             req = st.entry.request
             n_emit = 0
             for i in range(a + 1):
@@ -803,10 +1035,27 @@ class Engine:
             key = np.asarray(
                 jax.random.PRNGKey(req.seed), np.uint32
             )
+            tr = self._tracer
+            if tr is not None:
+                tr.end_span(entry.qspan)
+                entry.qspan = None
+            t0 = tr.clock() if tr is not None else 0.0
             first = self.decoder.prefill(
                 slot, req.prompt, key, req.temperature
             )
             self._slots[slot] = _SlotState(entry, len(req.prompt), first)
+            if tr is not None and entry.ctx is not None:
+                # the v1 prefill is fenced (returns a host int), so
+                # this span IS the wall-honest prefill leg
+                tr.record_span(
+                    entry.ctx, "prefill", t0, tr.clock(),
+                    parent_id=self._parent_of(entry),
+                    n_prompt=len(req.prompt),
+                )
+                self._slots[slot].dec_span = tr.start_span(
+                    entry.ctx, "decode",
+                    parent_id=self._parent_of(entry),
+                )
             self._tokens[slot] = first
             self._lengths[slot] = len(req.prompt)
             self._keys[slot] = key
@@ -996,6 +1245,12 @@ class Engine:
             self._active[slot] = False
             if self._paged:
                 self._mgr.free_slot(slot)
+            if self._tracer is not None:
+                self._tracer.end_span(st.pf_span, force=True,
+                                      reason=reason)
+                self._tracer.end_span(st.dec_span, force=True,
+                                      reason=reason)
+                st.pf_span = st.dec_span = None
             self._shed(st.entry, reason, now)
             n += 1
         return n

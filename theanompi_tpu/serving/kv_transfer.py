@@ -51,10 +51,14 @@ HANDOFF_FIELDS = (
 
 
 def build_handoff(decoder, manager, slot: int, n_prompt: int,
-                  first_token: int) -> dict:
+                  first_token: int, trace: dict | None = None) -> dict:
     """Export ``slot``'s prompt KV (the first ``blocks_for(n_prompt)``
     table entries) plus the sampled first token as a portable record.
-    Call BEFORE the slot's blocks are freed."""
+    Call BEFORE the slot's blocks are freed.  ``trace`` (optional,
+    NOT part of the ``compatible`` contract) carries the prefill
+    side's span context so a router-less receiver still joins the
+    decode leg's spans to the same trace; routed dispatches re-stamp
+    ``Request.trace`` anyway."""
     n_blocks = manager.blocks_for(n_prompt)
     bids = manager.slot_blocks(slot, n_blocks)
     return {
@@ -68,6 +72,7 @@ def build_handoff(decoder, manager, slot: int, n_prompt: int,
         "head_dim": int(decoder.model.head_dim),
         "dtype": str(np.dtype(decoder.pools[0]["k"].dtype)),
         "layers": decoder.export_blocks(bids),
+        "trace": dict(trace) if trace is not None else None,
     }
 
 

@@ -66,10 +66,15 @@ def result_to_dict(r: Result) -> dict:
         "tpot_s": r.tpot_s, "queued_s": r.queued_s, "e2e_s": r.e2e_s,
         # numpy KV payloads ride the pickle frames as-is
         "handoff": r.handoff,
+        # the request's span flight record (obs/tracer.py) — the
+        # router ingests it, so the tree survives this process
+        "spans": list(r.spans),
     }
 
 
 def result_from_dict(d: dict) -> Result:
+    d = dict(d)
+    d.setdefault("spans", [])   # pre-tracing peers
     return Result(**d)
 
 
@@ -216,6 +221,16 @@ class InProcessReplica:
     def paging_stats(self) -> dict | None:
         return self.engine.paging_stats()
 
+    def trace_state(self) -> list:
+        """The engine's span ring (flight-recorder salvage hook: the
+        router pulls this when the loop dies, so in-flight requests'
+        spans outlive the crash)."""
+        tr = self.engine.tracer
+        return tr.spans() if tr is not None else []
+
+    def metrics_txt(self) -> str:
+        return self.engine.recorder.metrics_txt()
+
     def reset_stats(self) -> None:
         """Fresh recorder + cleared radix cache — the bench's
         between-arm reset."""
@@ -333,12 +348,21 @@ class ReplicaServer:
                                 payload.get("prefill_only", False)
                             ),
                             handoff=payload.get("handoff"),
+                            trace=payload.get("trace"),
                         )
                         self.replica.submit(req).add_done_callback(
                             lambda r, rid=rid: push(
                                 ("result", (rid, result_to_dict(r)))
                             )
                         )
+                    elif cmd == "trace":
+                        push(("reply", (payload, {
+                            "spans": self.replica.trace_state(),
+                        })))
+                    elif cmd == "metrics":
+                        push(("reply", (payload, {
+                            "text": self.replica.metrics_txt(),
+                        })))
                     elif cmd == "ping":
                         push(("reply", (payload, {
                             "hb": self.replica.heartbeat(),
@@ -503,16 +527,28 @@ class TCPReplicaClient:
             fut._set(Result(status="shed",
                             finish_reason="replica_dead"))
 
-    def _command(self, cmd: str, timeout: float = 30.0):
+    def _command(self, cmd: str, timeout: float = 30.0,
+                 even_if_dead: bool = False):
+        """``even_if_dead`` keeps trying the WIRE after the liveness
+        verdict went dead: a fault drill that killed the remote
+        ENGINE LOOP leaves the frame-serving threads alive, and the
+        flight-recorder salvage wants exactly that window.  A truly
+        dead socket still fails fast (the send raises)."""
         nonce = next(self._nonce)
         slot = [threading.Event(), None]
         with self._lock:
             self._replies[nonce] = slot
         try:
             self._send((cmd, nonce))
-            if not slot[0].wait(timeout) or self.dead:
+            if not slot[0].wait(timeout) or (
+                self.dead and not even_if_dead
+            ):
                 raise ConnectionError(
                     f"{self.name}: no {cmd} reply"
+                )
+            if slot[1] is None and self.dead:
+                raise ConnectionError(
+                    f"{self.name}: wire died before {cmd} reply"
                 )
             return slot[1]
         finally:
@@ -565,6 +601,7 @@ class TCPReplicaClient:
                 "seed": request.seed,
                 "prefill_only": request.prefill_only,
                 "handoff": request.handoff,
+                "trace": request.trace,
             }))
         except ConnectionError:
             with self._lock:
@@ -609,6 +646,17 @@ class TCPReplicaClient:
     def stats(self, timeout: float = 30.0) -> dict:
         return self._command("stats", timeout)
 
+    def trace_state(self, timeout: float = 10.0) -> list:
+        """Pull the remote engine's span ring — the router's salvage
+        hook, so it tries the wire EVEN AFTER the liveness verdict
+        went dead (a die_replica drill kills the engine loop, not the
+        frame server).  Short timeout: salvage is best-effort."""
+        return self._command("trace", timeout,
+                             even_if_dead=True)["spans"]
+
+    def metrics_txt(self, timeout: float = 30.0) -> str:
+        return self._command("metrics", timeout)["text"]
+
     def paging_stats(self, timeout: float = 30.0) -> dict | None:
         return self._command("stats", timeout)["paging"]
 
@@ -642,7 +690,10 @@ def serve_replica_main(argv=None) -> None:
     Spec keys: ``config`` (model dict incl. ``tp``), ``checkpoint``
     (dir), ``paged`` (bool), ``decoder`` (decoder kwargs), ``engine``
     (Engine kwargs), ``name``/``index``, ``host``/``port``,
-    ``role`` (``unified``/``prefill``/``decode`` — serving v4).
+    ``role`` (``unified``/``prefill``/``decode`` — serving v4),
+    ``trace_sample`` (int, 0 = off — span tracing with this replica's
+    name as the Perfetto process lane and its role as the thread
+    lane; the router stitches the spans it ships back on Results).
     """
     import argparse
     import json
@@ -660,11 +711,21 @@ def serve_replica_main(argv=None) -> None:
         paged=bool(spec.get("paged", False)),
         **dict(spec.get("decoder", {})),
     )
+    index = int(spec.get("index", 0))
+    tracer = None
+    if int(spec.get("trace_sample", 0)) > 0:
+        from theanompi_tpu.obs import Tracer
+
+        tracer = Tracer(
+            process=spec.get("name", f"replica{index}"),
+            lane=spec.get("role", "unified"),
+            sample=int(spec["trace_sample"]),
+        )
     eng = Engine(
         dec, recorder=ServingRecorder(max_slots=dec.max_slots),
+        tracer=tracer,
         **dict(spec.get("engine", {})),
     )
-    index = int(spec.get("index", 0))
     srv = ReplicaServer(
         eng, name=spec.get("name", f"replica{index}"), index=index,
         host=spec.get("host", "127.0.0.1"),

@@ -89,6 +89,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from theanompi_tpu.obs.tracer import Tracer, child_context, force_sample
 from theanompi_tpu.serving.engine import (
     Request,
     Result,
@@ -201,6 +202,7 @@ class _FleetEntry:
         "rid", "request", "future", "submit_t", "deadline_s",
         "member", "gen", "n_requeues", "affinity_key", "dispatch_t",
         "handoff", "ttft_prefill", "disagg_ok",
+        "ctx", "root", "dspan", "qspan",
     )
 
     def __init__(self, rid: int, request: Request,
@@ -221,6 +223,12 @@ class _FleetEntry:
         self.handoff: dict | None = None
         self.ttft_prefill: float | None = None
         self.disagg_ok = True   # cleared after a failed handoff
+        # tracing (obs/tracer.py): span context, root "request" span,
+        # open dispatch-hop span, open router-queue span
+        self.ctx: dict | None = None
+        self.root: dict | None = None
+        self.dspan: dict | None = None
+        self.qspan: dict | None = None
 
 
 class Router:
@@ -241,6 +249,10 @@ class Router:
         n_vnodes: int = 64,
         max_requeues: int = 3,
         recorder: FleetRecorder | None = None,
+        tracer: Tracer | None = None,
+        trace_sample: int = 0,
+        trace_slo_ttft_s: float | None = None,
+        trace_slo_e2e_s: float | None = None,
     ):
         if policy not in POLICIES:
             raise ValueError(
@@ -258,6 +270,16 @@ class Router:
         self.affinity_block = int(affinity_block)
         self.max_requeues = int(max_requeues)
         self.recorder = recorder or FleetRecorder()
+        # span tracing (obs/tracer.py): the router owns each
+        # request's ROOT span and the per-generation dispatch spans;
+        # every Result's replica-side flight record is ingested here,
+        # so one connected tree per request survives replica death.
+        # Shed / failover / SLO-miss force-sample their traces.
+        if tracer is None and int(trace_sample) > 0:
+            tracer = Tracer(process="router", sample=int(trace_sample))
+        self.tracer = tracer
+        self.trace_slo_ttft_s = trace_slo_ttft_s
+        self.trace_slo_e2e_s = trace_slo_e2e_s
 
         self._lock = threading.RLock()
         self._members: list[_Member] = []
@@ -345,6 +367,7 @@ class Router:
             pass      # dead/unreachable: keep the last snapshot
         else:
             self.recorder.attach_replica(m.name, state, paging)
+        self._salvage_trace(m)   # retired members keep no spans
         with self._lock:
             self._members = [x for x in self._members if x is not m]
             self._ring.remove(name)
@@ -403,6 +426,14 @@ class Router:
             prefix_affinity_key(req.prompt, self.affinity_block)
             if self.policy == "prefix_affinity" else b"",
         )
+        if self.tracer is not None:
+            entry.ctx = self.tracer.new_context()
+            entry.root = self.tracer.start_span(
+                entry.ctx, "request", n_prompt=len(req.prompt)
+            )
+            # callers (and the critical_path report) find the trace
+            # through the future they already hold
+            entry.future.trace_id = entry.ctx["trace_id"]
         with self._lock:
             if self._stopping:
                 reason = "shutdown"
@@ -417,10 +448,10 @@ class Router:
                     # on any freed capacity — a fresh submit must not
                     # race past them to a slot and starve them to
                     # "deadline"
-                    self._queue.append(entry.rid)
+                    self._enqueue_locked(entry)
                     self._pump_locked()
                 elif not self._try_dispatch(entry):
-                    self._queue.append(entry.rid)
+                    self._enqueue_locked(entry)
         if reason is not None:
             # admission sheds resolve OUTSIDE the lock (same shape as
             # Engine.submit): the entry was never published, so only
@@ -429,8 +460,31 @@ class Router:
             return self._shed(entry, reason)
         return entry.future
 
+    def _root_id(self, entry: _FleetEntry) -> int | None:
+        return entry.root["span_id"] if entry.root is not None else None
+
+    def _enqueue_locked(self, entry: _FleetEntry) -> None:  # tmcheck: holds=_lock
+        """Queue a router-held entry, opening its router_queue span
+        (the named leg the critical path shows for backpressure)."""
+        if (self.tracer is not None and entry.ctx is not None
+                and entry.qspan is None):
+            entry.qspan = self.tracer.start_span(
+                entry.ctx, "router_queue",
+                parent_id=self._root_id(entry),
+            )
+        self._queue.append(entry.rid)
+
     def _shed(self, entry: _FleetEntry, reason: str) -> ServingFuture:
         now = time.monotonic()
+        if self.tracer is not None and entry.ctx is not None:
+            # a shed is exactly the tail 1/N sampling must not lose
+            force_sample(entry.ctx)
+            self.tracer.end_span(entry.qspan, reason=reason)
+            self.tracer.end_span(entry.dspan, outcome=reason)
+            entry.qspan = entry.dspan = None
+            self.tracer.end_span(entry.root, status="shed",
+                                 finish_reason=reason)
+            entry.root = None
         entry.future._set(Result(
             status="shed", finish_reason=reason,
             queued_s=now - entry.submit_t,
@@ -552,12 +606,27 @@ class Router:
         entry.dispatch_t = now
         gen = entry.gen
         req = entry.request
+        trace_ctx = None
+        if self.tracer is not None and entry.ctx is not None:
+            self.tracer.end_span(entry.qspan)
+            entry.qspan = None
+            entry.dspan = self.tracer.start_span(
+                entry.ctx, "dispatch", parent_id=self._root_id(entry),
+                member=member.name, mode=mode, gen=gen,
+            )
+            # the replica's spans parent under THIS dispatch hop —
+            # the context (incl. the sampled bit) rides the Request
+            # across the TCP frames unchanged
+            trace_ctx = child_context(
+                entry.ctx, entry.dspan["span_id"]
+            )
         efut = member.replica.submit(Request(
             prompt=list(req.prompt), max_tokens=req.max_tokens,
             temperature=req.temperature, deadline_s=remaining,
             seed=req.seed,
             prefill_only=(mode == "prefill"),
             handoff=entry.handoff,
+            trace=trace_ctx,
         ))
         self.recorder.record_dispatch(member.name)
         # deliberate register-under-RLock: an already-resolved efut
@@ -573,6 +642,11 @@ class Router:
     # -- completion (replica threads) --------------------------------------
 
     def _on_result(self, rid: int, gen: int, res: Result) -> None:
+        if self.tracer is not None and res.spans:
+            # the replica-side flight record — ingested for EVERY
+            # delivery (stale/duplicate results are real duplicated
+            # work on the same tree; span-id dedup handles replays)
+            self.tracer.ingest(res.spans)
         with self._lock:
             entry = self._pending.get(rid)
             if entry is None or entry.gen != gen:
@@ -598,12 +672,22 @@ class Router:
                 entry.gen += 1        # invalidate the prefill hop
                 entry.member = None
                 self.recorder.record_handoff()
+                if self.tracer is not None and entry.ctx is not None:
+                    self.tracer.end_span(entry.dspan,
+                                         outcome="prefilled")
+                    entry.dspan = None
+                    t = self.tracer.clock()
+                    self.tracer.record_span(
+                        entry.ctx, "handoff", t, t,
+                        parent_id=self._root_id(entry),
+                        n_blocks=res.handoff.get("n_blocks"),
+                    )
                 if self._queue:
                     # FIFO fairness, same as submit()
-                    self._queue.append(rid)
+                    self._enqueue_locked(entry)
                     self._pump_locked()
                 elif not self._try_dispatch(entry):
-                    self._queue.append(rid)
+                    self._enqueue_locked(entry)
                 return
             if (
                 res.status == "shed"
@@ -656,6 +740,28 @@ class Router:
                 res.e2e_s + shift if res.e2e_s is not None else None
             ),
         )
+        if self.tracer is not None and entry.ctx is not None:
+            slo_miss = (
+                (self.trace_slo_ttft_s is not None
+                 and out.ttft_s is not None
+                 and out.ttft_s > self.trace_slo_ttft_s)
+                or (self.trace_slo_e2e_s is not None
+                    and out.e2e_s is not None
+                    and out.e2e_s > self.trace_slo_e2e_s)
+            )
+            if out.status == "shed" or slo_miss:
+                # keep the interesting tail — forced BEFORE the
+                # still-open dispatch span ends, so the kept trace
+                # carries its member/mode leg, not just the root
+                force_sample(entry.ctx)
+            self.tracer.end_span(entry.dspan,
+                                 outcome=out.finish_reason)
+            entry.dspan = None
+            self.tracer.end_span(
+                entry.root, status=out.status,
+                finish_reason=out.finish_reason, slo_miss=slo_miss,
+            )
+            entry.root = None
         entry.future._set(out)
         self.recorder.record_request(
             status=out.status, finish_reason=out.finish_reason,
@@ -676,6 +782,19 @@ class Router:
         for entry in entries:
             entry.gen += 1        # invalidate in-flight callbacks
             entry.member = None
+            if self.tracer is not None and entry.ctx is not None:
+                # failover is an always-sample event: the forced bit
+                # rides every later dispatch, so the retry legs are
+                # fully traced even at 1/N
+                force_sample(entry.ctx)
+                self.tracer.end_span(entry.dspan, outcome="requeue")
+                entry.dspan = None
+                t = self.tracer.clock()
+                self.tracer.record_span(
+                    entry.ctx, "requeue", t, t,
+                    parent_id=self._root_id(entry),
+                    gen=entry.gen, charged=charge,
+                )
             if charge:
                 if entry.n_requeues >= self.max_requeues:
                     del self._pending[entry.rid]
@@ -685,7 +804,7 @@ class Router:
                     self._shed(entry, "failover")  # tmcheck: disable=TM103
                     continue
                 entry.n_requeues += 1
-            self._queue.append(entry.rid)
+            self._enqueue_locked(entry)
             n += 1
         if n:
             self.recorder.record_requeue(n)
@@ -701,6 +820,25 @@ class Router:
                 if e.member is member
             ]
             self._requeue_locked(affected)
+        # pull the flight recorder from the wreck: a replica whose
+        # LOOP died (fault drill, crash) often still answers its
+        # wire/object, so the spans of the requests it was serving —
+        # which never got a Result to ride — survive into the
+        # router's ring.  Best-effort, OUTSIDE the lock (wire call).
+        self._salvage_trace(member)
+
+    def _salvage_trace(self, member: _Member) -> None:
+        if self.tracer is None:
+            return
+        fn = getattr(member.replica, "trace_state", None)
+        if fn is None:
+            return
+        try:
+            spans = fn()
+        except Exception:
+            return      # truly gone: its unsent spans die with it
+        if spans:
+            self.tracer.ingest(spans)
 
     # -- health monitor ----------------------------------------------------
 
@@ -866,3 +1004,52 @@ class Router:
         out["members"] = self.members()
         out["policy"] = self.policy
         return out
+
+    def metrics_txt(self) -> str:
+        """Prometheus-style text for the whole fleet, on demand —
+        pulls fresh replica recorder states first (no HTTP server;
+        dump it wherever the scrape lives)."""
+        self.refresh_replica_stats()
+        return self.recorder.metrics_txt()
+
+    # -- tracing (obs/) ----------------------------------------------------
+
+    def collect_spans(self, trace_id: int | None = None) -> list:
+        """Router-ring spans, after best-effort pulls of every
+        reachable replica's flight recorder (covers traces still in
+        flight; completed requests' spans already rode their
+        Results).  Wire calls happen OUTSIDE the router lock."""
+        if self.tracer is None:
+            return []
+        with self._lock:
+            members = list(self._members)
+        for m in members:
+            self._salvage_trace(m)
+        return self.tracer.spans(trace_id)
+
+    def critical_path(self, trace_id: int) -> dict | None:
+        """The "why was this request slow" report (obs/export.py):
+        the longest serial chain with per-leg durations, from the
+        router's stitched tree.  ``trace_id`` comes from the
+        submitted future's ``trace_id`` attribute.  Returns ``None``
+        when the ring holds no spans for that trace — at 1/N
+        sampling that is most requests (unsampled and uneventful:
+        shed/failover/SLO-miss traces are always kept, and
+        ``trace_sample=1`` keeps everything)."""
+        from theanompi_tpu.obs import export
+
+        if self.tracer is None:
+            return None
+        spans = self.tracer.spans(trace_id)
+        if not spans:
+            return None
+        return export.critical_path(spans, trace_id)
+
+    def export_trace(self, path, trace_id: int | None = None) -> str:
+        """Write the Perfetto-openable Chrome-trace JSON for one
+        trace (or everything in the ring) to ``path``."""
+        from theanompi_tpu.obs import export
+
+        return export.write_chrome_trace(
+            self.collect_spans(trace_id), path, trace_id=trace_id
+        )

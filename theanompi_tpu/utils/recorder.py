@@ -23,14 +23,20 @@ For intra-step comm attribution use ``jax.profiler`` traces
 from __future__ import annotations
 
 import json
+import random
 import time
-from collections import Counter
+from collections import Counter, deque
 from pathlib import Path
 from typing import Optional
 
 import numpy as np
 
 MODES = ("calc", "comm", "wait")
+
+#: recorder segment -> span name in the training trace (the phases
+#: Theano-MPI's per-iteration breakdown named: load the batch, run
+#: the step, exchange the gradients)
+_MODE_SPAN = {"calc": "step", "comm": "exchange", "wait": "load"}
 
 
 class Recorder:
@@ -65,11 +71,51 @@ class Recorder:
         # checkpoints so the FINAL summary shows the whole run's
         # restart history, not just the last process's.
         self.restart_events: list[dict] = []
+        # span tracing (theanompi_tpu/obs): attach_tracer() turns the
+        # per-iteration calc/comm/wait segments into load/step/
+        # exchange spans riding the iteration-boundary heartbeat
+        self._tracer = None
+        self._iter_ctx: dict | None = None
+        self._iter_root: dict | None = None
+        self._t0_trace: float | None = None
+
+    # -- span tracing (obs/tracer.py) --------------------------------------
+
+    def attach_tracer(self, tracer) -> None:
+        """Record each sampled ITERATION as one trace (root span
+        ``iteration``) whose children are the load/step/exchange
+        phase spans the ``start()``/``end(mode)`` segments already
+        measure.  The tracer's own ``sample`` knob decides which
+        iterations trace; call :meth:`trace_boundary` at the
+        iteration boundary (next to the supervisor heartbeat)."""
+        self._tracer = tracer
+
+    def trace_boundary(self, iteration: int | None = None) -> None:
+        """Close the current iteration's trace and open the next —
+        the BSP worker calls this where it stamps its heartbeat."""
+        if self._tracer is None:
+            return
+        if self._iter_root is not None:
+            self._tracer.end_span(self._iter_root)
+        self._iter_ctx = self._tracer.new_context()
+        self._iter_root = self._tracer.start_span(
+            self._iter_ctx, "iteration",
+            iteration=int(iteration if iteration is not None
+                          else self.n_iter),
+        )
+
+    def finish_trace(self) -> None:
+        """Close the trailing open iteration span (end of run)."""
+        if self._tracer is not None and self._iter_root is not None:
+            self._tracer.end_span(self._iter_root)
+            self._iter_root = self._iter_ctx = None
 
     # -- wall-clock segments (reference: start()/end(mode)) ---------------
 
     def start(self) -> None:
         self._t0 = time.perf_counter()
+        if self._tracer is not None and self._iter_root is not None:
+            self._t0_trace = self._tracer.clock()
 
     def end(self, mode: str) -> None:
         assert mode in MODES, mode
@@ -79,6 +125,16 @@ class Recorder:
         self.segments[mode] += dt
         self.epoch_segments[mode] += dt
         self._t0 = None
+        if (
+            self._tracer is not None and self._iter_root is not None
+            and self._t0_trace is not None
+        ):
+            self._tracer.record_span(
+                self._iter_ctx, _MODE_SPAN[mode], self._t0_trace,
+                self._tracer.clock(),
+                parent_id=self._iter_root["span_id"],
+            )
+            self._t0_trace = None
 
     # -- train/val bookkeeping -------------------------------------------
 
@@ -301,6 +357,87 @@ def _percentile(xs: list[float], q: float) -> float | None:
         else None
 
 
+class Reservoir:
+    """Bounded uniform sample of an unbounded stream (Vitter's
+    algorithm R) — the fix for the ServingRecorder's per-request
+    latency lists growing without limit over a long-running fleet.
+    Exact (= the full sample) below ``cap``; past it, each stream
+    element survives with probability cap/n, so percentiles stay
+    unbiased estimates.  ``merge`` folds another reservoir in with
+    draws weighted by the two streams' true counts, so merged fleet
+    percentiles track the pooled distribution (tolerance asserted in
+    tests/test_tracing.py).  Deterministic: seeded ``random.Random``,
+    no global RNG."""
+
+    __slots__ = ("cap", "n", "xs", "_rng")
+
+    def __init__(self, cap: int = 2048, seed: int = 0):
+        self.cap = max(1, int(cap))
+        self.n = 0
+        self.xs: list[float] = []
+        self._rng = random.Random(seed)
+
+    def add(self, x: float) -> None:
+        self.n += 1
+        if len(self.xs) < self.cap:
+            self.xs.append(float(x))
+        else:
+            j = self._rng.randrange(self.n)
+            if j < self.cap:
+                self.xs[j] = float(x)
+
+    def merge(self, other_xs, other_n: int) -> None:
+        """Fold a foreign sample of a stream of ``other_n`` items."""
+        b_xs = [float(x) for x in other_xs]
+        b_n = int(other_n)
+        if b_n <= 0 or not b_xs:
+            return
+        if not self.xs:
+            keep = b_xs if len(b_xs) <= self.cap else \
+                self._rng.sample(b_xs, self.cap)
+            self.xs = list(keep)
+            self.n = b_n
+            return
+        a_xs, a_n = self.xs, self.n
+        if len(a_xs) + len(b_xs) <= self.cap:
+            self.xs = a_xs + b_xs
+            self.n = a_n + b_n
+            return
+        a_sh = a_xs[:]
+        b_sh = b_xs[:]
+        self._rng.shuffle(a_sh)
+        self._rng.shuffle(b_sh)
+        out: list[float] = []
+        ai = bi = 0
+        p_a = a_n / (a_n + b_n)
+        while len(out) < self.cap and (ai < len(a_sh) or bi < len(b_sh)):
+            take_a = (
+                ai < len(a_sh)
+                and (bi >= len(b_sh) or self._rng.random() < p_a)
+            )
+            if take_a:
+                out.append(a_sh[ai])
+                ai += 1
+            else:
+                out.append(b_sh[bi])
+                bi += 1
+        self.xs = out
+        self.n = a_n + b_n
+
+    def percentile(self, q: float) -> float | None:
+        return _percentile(self.xs, q)
+
+    def state(self) -> dict:
+        return {"cap": self.cap, "n": self.n, "xs": list(self.xs)}
+
+    @classmethod
+    def from_state(cls, d: dict, seed: int = 0) -> "Reservoir":
+        r = cls(cap=d["cap"], seed=seed)
+        r.n = int(d["n"])
+        r.xs = [float(x) for x in d["xs"]]
+        return r
+
+
 class ServingRecorder:
     """Telemetry sink for the continuous-batching engine: per-request
     TTFT/TPOT, aggregate tokens/s over decode time, slot occupancy,
@@ -319,14 +456,39 @@ class ServingRecorder:
     depth at the step, step seconds, tokens emitted, and — paged
     serving only — the block gauges ``blocks_in_use``/``blocks_free``
     at the step.
+
+    **Bounded memory** (a long-running fleet must not grow without
+    limit): the raw ``requests``/``steps`` lists are rolling windows
+    of the last ``max_samples`` entries, every aggregate the summary
+    reports is maintained EXACTLY in incremental counters, and the
+    TTFT/TPOT percentiles come from seeded :class:`Reservoir`
+    samples — exact below ``max_samples``, unbiased estimates past
+    it, and mergeable fleet-wide with count-weighted draws.
     """
 
-    def __init__(self, max_slots: int = 1):
+    def __init__(self, max_slots: int = 1, *,
+                 max_samples: int = 4096, seed: int = 0):
         self.max_slots = int(max_slots)
-        self.requests: list[dict] = []
-        self.steps: list[dict] = []
+        self.max_samples = int(max_samples)
+        self.requests: deque = deque(maxlen=self.max_samples)
+        self.steps: deque = deque(maxlen=self.max_samples)
         self.blocks_in_use_max: int | None = None
         self.blocks_free_min: int | None = None
+        self._ttft = Reservoir(self.max_samples, seed)
+        self._tpot = Reservoir(self.max_samples, seed + 1)
+        self._agg = self._zero_agg()
+
+    @staticmethod
+    def _zero_agg() -> dict:
+        return {
+            "n_ok": 0, "n_shed": 0,
+            "shed_reasons": Counter(), "finish_reasons": Counter(),
+            "tokens_completed": 0, "hit_tokens": 0, "prompt_tokens": 0,
+            "decode_s": 0.0, "tokens": 0,
+            "cap_slot_s": 0.0, "act_slot_s": 0.0,
+            "depth_sum": 0, "depth_n": 0, "depth_max": None,
+            "drafted": 0, "accepted": 0, "slot_steps": 0,
+        }
 
     def record_request(
         self,
@@ -341,7 +503,7 @@ class ServingRecorder:
         e2e_s: float | None = None,
         n_prefix_hit: int = 0,
     ) -> None:
-        self.requests.append({
+        r = {
             "status": status,
             "finish_reason": finish_reason,
             "n_prompt": int(n_prompt),
@@ -351,7 +513,25 @@ class ServingRecorder:
             "queued_s": queued_s,
             "e2e_s": e2e_s,
             "n_prefix_hit": int(n_prefix_hit),
-        })
+        }
+        self.requests.append(r)
+        self._fold_request(r)
+
+    def _fold_request(self, r: dict) -> None:
+        a = self._agg
+        if r["status"] == "ok":
+            a["n_ok"] += 1
+            a["finish_reasons"][r["finish_reason"]] += 1
+            a["tokens_completed"] += int(r["n_generated"])
+            a["hit_tokens"] += int(r.get("n_prefix_hit", 0) or 0)
+            a["prompt_tokens"] += int(r["n_prompt"])
+            if r.get("ttft_s") is not None:
+                self._ttft.add(r["ttft_s"])
+            if r.get("tpot_s") is not None:
+                self._tpot.add(r["tpot_s"])
+        else:
+            a["n_shed"] += 1
+            a["shed_reasons"][r["finish_reason"]] += 1
 
     def record_step(
         self,
@@ -365,7 +545,7 @@ class ServingRecorder:
         drafted: int | None = None,
         accepted: int | None = None,
     ) -> None:
-        self.steps.append({
+        s = {
             "active_slots": int(active_slots),
             "queue_depth": int(queue_depth),
             "dt_s": float(dt_s),
@@ -377,10 +557,32 @@ class ServingRecorder:
             # non-speculative path
             "drafted": drafted,
             "accepted": accepted,
-        })
+        }
+        self.steps.append(s)
+        self._fold_step(s)
         self.record_block_gauges(
             blocks_in_use=blocks_in_use, blocks_free=blocks_free
         )
+
+    def _fold_step(self, s: dict) -> None:
+        a = self._agg
+        dt = float(s["dt_s"])
+        a["decode_s"] += dt
+        a["tokens"] += int(s["tokens"])
+        # merged steps carry their OWN recorder's max_slots stamp
+        # (see merge()); local steps use ours
+        a["cap_slot_s"] += s.get("max_slots", self.max_slots) * dt
+        a["act_slot_s"] += int(s["active_slots"]) * dt
+        a["depth_sum"] += int(s["queue_depth"])
+        a["depth_n"] += 1
+        a["depth_max"] = (
+            int(s["queue_depth"]) if a["depth_max"] is None
+            else max(a["depth_max"], int(s["queue_depth"]))
+        )
+        a["drafted"] += int(s.get("drafted") or 0)
+        a["accepted"] += int(s.get("accepted") or 0)
+        if s["tokens"] > 0:
+            a["slot_steps"] += int(s["active_slots"])
 
     def record_block_gauges(
         self,
@@ -407,39 +609,102 @@ class ServingRecorder:
     # -- aggregation (fleet serving, utils/recorder.FleetRecorder) ---------
 
     def state_dict(self) -> dict:
-        """JSON-able raw state — what a TCP replica ships to the
-        router's ``FleetRecorder`` so fleet percentiles come from the
-        full sample, not from re-aggregated per-replica medians."""
+        """JSON-able state — what a TCP replica ships to the router's
+        ``FleetRecorder``: exact aggregates + reservoir samples (and
+        the rolling raw windows for inspection), so fleet percentiles
+        merge from count-weighted samples, never from re-aggregated
+        per-replica medians."""
+        agg = dict(self._agg)
+        agg["shed_reasons"] = dict(agg["shed_reasons"])
+        agg["finish_reasons"] = dict(agg["finish_reasons"])
         return {
             "max_slots": self.max_slots,
             "requests": [dict(r) for r in self.requests],
             "steps": [dict(s) for s in self.steps],
             "blocks_in_use_max": self.blocks_in_use_max,
             "blocks_free_min": self.blocks_free_min,
+            "agg": agg,
+            "ttft_res": self._ttft.state(),
+            "tpot_res": self._tpot.state(),
         }
+
+    def _adopt_agg(self, d: dict) -> None:
+        a = self._zero_agg()
+        for k, v in d.items():
+            if k in ("shed_reasons", "finish_reasons"):
+                a[k] = Counter(v)
+            else:
+                a[k] = v
+        self._agg = a
 
     def load_state_dict(self, d: dict) -> None:
         self.max_slots = int(d["max_slots"])
-        self.requests = [dict(r) for r in d["requests"]]
-        self.steps = [dict(s) for s in d["steps"]]
+        self.requests = deque(
+            (dict(r) for r in d["requests"]), maxlen=self.max_samples
+        )
+        self.steps = deque(
+            (dict(s) for s in d["steps"]), maxlen=self.max_samples
+        )
         self.blocks_in_use_max = d.get("blocks_in_use_max")
         self.blocks_free_min = d.get("blocks_free_min")
+        self._ttft = Reservoir(self.max_samples, 0)
+        self._tpot = Reservoir(self.max_samples, 1)
+        self._agg = self._zero_agg()
+        if "agg" in d:
+            self._adopt_agg(d["agg"])
+            self._ttft.merge(d["ttft_res"]["xs"], d["ttft_res"]["n"])
+            self._tpot.merge(d["tpot_res"]["xs"], d["tpot_res"]["n"])
+        else:
+            # pre-bounding state (old checkpoints/peers): the lists
+            # ARE the full sample — rebuild the aggregates exactly
+            # from the SOURCE lists, not the bounded deques (a state
+            # larger than max_samples already lost its head there)
+            for r in d["requests"]:
+                self._fold_request(dict(r))
+            for s in d["steps"]:
+                self._fold_step(dict(s))
 
     def merge(self, other) -> "ServingRecorder":
         """Fold another recorder (or its ``state_dict()``) into this
-        one: requests and steps append, block gauges take the
-        extremes.  Merged steps are stamped with THEIR recorder's
-        ``max_slots`` so the combined ``slot_occupancy`` stays a
-        slot-seconds-weighted mean even when replicas differ in slot
-        count.  Returns ``self`` (chainable)."""
+        one: aggregates add exactly, reservoirs merge count-weighted,
+        raw windows append (bounded), block gauges take the extremes.
+        Merged steps are stamped with THEIR recorder's ``max_slots``
+        so the combined ``slot_occupancy`` stays a slot-seconds-
+        weighted mean even when replicas differ in slot count.
+        Returns ``self`` (chainable)."""
         d = other.state_dict() if isinstance(other, ServingRecorder) \
             else other
-        self.requests.extend(dict(r) for r in d["requests"])
         slots = int(d["max_slots"])
+        stamped = []
         for s in d["steps"]:
             s = dict(s)
             s.setdefault("max_slots", slots)
-            self.steps.append(s)
+            stamped.append(s)
+        self.requests.extend(dict(r) for r in d["requests"])
+        self.steps.extend(stamped)
+        if "agg" in d:
+            a, b = self._agg, d["agg"]
+            for k in ("n_ok", "n_shed", "tokens_completed",
+                      "hit_tokens", "prompt_tokens", "decode_s",
+                      "tokens", "cap_slot_s", "act_slot_s",
+                      "depth_sum", "depth_n", "drafted", "accepted",
+                      "slot_steps"):
+                a[k] += b[k]
+            a["shed_reasons"].update(b["shed_reasons"])
+            a["finish_reasons"].update(b["finish_reasons"])
+            if b.get("depth_max") is not None:
+                a["depth_max"] = (
+                    b["depth_max"] if a["depth_max"] is None
+                    else max(a["depth_max"], b["depth_max"])
+                )
+            self._ttft.merge(d["ttft_res"]["xs"], d["ttft_res"]["n"])
+            self._tpot.merge(d["tpot_res"]["xs"], d["tpot_res"]["n"])
+        else:
+            # old-format peer: its lists are the full sample
+            for r in d["requests"]:
+                self._fold_request(dict(r))
+            for s in stamped:
+                self._fold_step(s)
         self.record_block_gauges(
             blocks_in_use=d.get("blocks_in_use_max"),
             blocks_free=d.get("blocks_free_min"),
@@ -448,76 +713,107 @@ class ServingRecorder:
 
     def summary(self) -> dict:
         """One dict the bench row emits: throughput, latency
-        percentiles, occupancy, queue pressure, shed accounting."""
-        ok = [r for r in self.requests if r["status"] == "ok"]
-        shed = [r for r in self.requests if r["status"] == "shed"]
-        ttfts = [r["ttft_s"] for r in ok if r["ttft_s"] is not None]
-        tpots = [r["tpot_s"] for r in ok if r["tpot_s"] is not None]
-        decode_s = sum(s["dt_s"] for s in self.steps)
-        tokens = sum(s["tokens"] for s in self.steps)
-        # merged steps carry their own max_slots (see merge()); the
-        # recorder's own steps use self.max_slots
-        cap_slot_s = sum(
-            s.get("max_slots", self.max_slots) * s["dt_s"]
-            for s in self.steps
-        )
+        percentiles, occupancy, queue pressure, shed accounting.
+        Every counter is exact (incremental aggregates); the
+        TTFT/TPOT percentiles come from the bounded reservoirs."""
+        a = self._agg
+        decode_s = a["decode_s"]
+        tokens = a["tokens"]
         occ = (
-            sum(s["active_slots"] * s["dt_s"] for s in self.steps)
-            / cap_slot_s
-            if cap_slot_s else None
+            a["act_slot_s"] / a["cap_slot_s"] if a["cap_slot_s"]
+            else None
         )
-        depths = [s["queue_depth"] for s in self.steps]
-        shed_reasons = dict(Counter(r["finish_reason"] for r in shed))
-        finish_reasons = dict(Counter(r["finish_reason"] for r in ok))
-        # paged-cache telemetry: prefix-cache hit rate over served
-        # prompt tokens, and the block gauges' extremes
-        hit_tokens = sum(r.get("n_prefix_hit", 0) for r in ok)
-        prompt_tokens = sum(r["n_prompt"] for r in ok)
         # speculative decoding: accept-rate over offered drafts and
         # tokens committed per SLOT-STEP (one slot, one decode/verify
         # dispatch) — exactly 1.0 when speculation is off or every
         # draft missed, > 1 when verify windows land; dividing by
         # slot-steps rather than steps keeps batch width out of the
         # speculation datum
-        drafted = sum(s.get("drafted") or 0 for s in self.steps)
-        accepted = sum(s.get("accepted") or 0 for s in self.steps)
-        slot_steps = sum(
-            s["active_slots"] for s in self.steps if s["tokens"] > 0
-        )
+        drafted, accepted = a["drafted"], a["accepted"]
         return {
-            "n_requests": len(self.requests),
-            "n_completed": len(ok),
-            "n_shed": len(shed),
-            "shed_reasons": shed_reasons,
+            "n_requests": a["n_ok"] + a["n_shed"],
+            "n_completed": a["n_ok"],
+            "n_shed": a["n_shed"],
+            "shed_reasons": dict(a["shed_reasons"]),
             "tokens_generated": tokens,   # decode-step tokens only
             # all tokens delivered to completed requests (includes
             # each request's prefill-sampled first token)
-            "tokens_completed": sum(r["n_generated"] for r in ok),
+            "tokens_completed": a["tokens_completed"],
             "decode_s": decode_s,
             "tokens_per_sec": tokens / decode_s if decode_s else None,
-            "ttft_p50_s": _percentile(ttfts, 50),
-            "ttft_p95_s": _percentile(ttfts, 95),
-            "tpot_p50_s": _percentile(tpots, 50),
-            "tpot_p95_s": _percentile(tpots, 95),
+            "ttft_p50_s": self._ttft.percentile(50),
+            "ttft_p95_s": self._ttft.percentile(95),
+            "tpot_p50_s": self._tpot.percentile(50),
+            "tpot_p95_s": self._tpot.percentile(95),
             "slot_occupancy": occ,
             "queue_depth_mean": (
-                float(np.mean(depths)) if depths else None
+                a["depth_sum"] / a["depth_n"] if a["depth_n"] else None
             ),
-            "queue_depth_max": max(depths) if depths else None,
-            "finish_reasons": finish_reasons,
+            "queue_depth_max": a["depth_max"],
+            "finish_reasons": dict(a["finish_reasons"]),
             "drafted_tokens": drafted,
             "accepted_tokens": accepted,
             "accept_rate": accepted / drafted if drafted else None,
             "tokens_per_step": (
-                tokens / slot_steps if slot_steps else None
+                tokens / a["slot_steps"] if a["slot_steps"] else None
             ),
-            "prefix_hit_tokens": hit_tokens,
+            "prefix_hit_tokens": a["hit_tokens"],
             "prefix_hit_rate": (
-                hit_tokens / prompt_tokens if prompt_tokens else None
+                a["hit_tokens"] / a["prompt_tokens"]
+                if a["prompt_tokens"] else None
             ),
             "blocks_in_use_max": self.blocks_in_use_max,
             "blocks_free_min": self.blocks_free_min,
         }
+
+    def metrics_txt(self, prefix: str = "tm_serving") -> str:
+        """Prometheus-style text exposition of the summary (stable
+        names; served by ``ReplicaServer`` as a ``metrics`` frame and
+        dumped by the router on demand — docs/OBSERVABILITY.md)."""
+        from theanompi_tpu.obs.metrics import (
+            quantile_samples,
+            render_metrics,
+        )
+
+        s = self.summary()
+        p = prefix
+        return render_metrics([
+            (f"{p}_requests_total", "counter", [
+                ({"status": "ok"}, s["n_completed"]),
+                ({"status": "shed"}, s["n_shed"]),
+            ]),
+            (f"{p}_sheds_total", "counter", [
+                ({"reason": r}, n)
+                for r, n in sorted(s["shed_reasons"].items())
+            ]),
+            (f"{p}_finish_total", "counter", [
+                ({"reason": r}, n)
+                for r, n in sorted(s["finish_reasons"].items())
+            ]),
+            (f"{p}_tokens_generated_total", "counter",
+             [(None, s["tokens_generated"])]),
+            (f"{p}_tokens_completed_total", "counter",
+             [(None, s["tokens_completed"])]),
+            (f"{p}_decode_seconds_total", "counter",
+             [(None, s["decode_s"])]),
+            (f"{p}_ttft_seconds", "summary", quantile_samples(
+                {"0.5": s["ttft_p50_s"], "0.95": s["ttft_p95_s"]})),
+            (f"{p}_tpot_seconds", "summary", quantile_samples(
+                {"0.5": s["tpot_p50_s"], "0.95": s["tpot_p95_s"]})),
+            (f"{p}_tokens_per_sec", "gauge",
+             [(None, s["tokens_per_sec"])]),
+            (f"{p}_slot_occupancy", "gauge",
+             [(None, s["slot_occupancy"])]),
+            (f"{p}_queue_depth_max", "gauge",
+             [(None, s["queue_depth_max"])]),
+            (f"{p}_prefix_hit_rate", "gauge",
+             [(None, s["prefix_hit_rate"])]),
+            (f"{p}_accept_rate", "gauge", [(None, s["accept_rate"])]),
+            (f"{p}_blocks_in_use_max", "gauge",
+             [(None, s["blocks_in_use_max"])]),
+            (f"{p}_blocks_free_min", "gauge",
+             [(None, s["blocks_free_min"])]),
+        ])
 
 
 class FleetRecorder:
@@ -692,3 +988,59 @@ class FleetRecorder:
         ]
         out["aggregate_tokens_per_sec"] = sum(rates) if rates else None
         return out
+
+    def metrics_txt(self, prefix: str = "tm_fleet") -> str:
+        """Prometheus-style text for the fleet: the router-side
+        request stream plus control-plane counters and per-replica
+        rate/occupancy gauges (labelled ``replica="name"``)."""
+        from theanompi_tpu.obs.metrics import (
+            quantile_samples,
+            render_metrics,
+        )
+
+        s = self.summary()
+        p = prefix
+        per = s.get("per_replica", {})
+        return render_metrics([
+            (f"{p}_requests_total", "counter", [
+                ({"status": "ok"}, s["n_completed"]),
+                ({"status": "shed"}, s["n_shed"]),
+            ]),
+            (f"{p}_sheds_total", "counter", [
+                ({"reason": r}, n)
+                for r, n in sorted(s["shed_reasons"].items())
+            ]),
+            (f"{p}_tokens_completed_total", "counter",
+             [(None, s["tokens_completed"])]),
+            (f"{p}_ttft_seconds", "summary", quantile_samples(
+                {"0.5": s["ttft_p50_s"], "0.95": s["ttft_p95_s"]})),
+            (f"{p}_tpot_seconds", "summary", quantile_samples(
+                {"0.5": s["tpot_p50_s"], "0.95": s["tpot_p95_s"]})),
+            (f"{p}_requeues_total", "counter",
+             [(None, s["n_requeues"])]),
+            (f"{p}_failovers_total", "counter",
+             [(None, s["n_failovers"])]),
+            (f"{p}_rejoins_total", "counter", [(None, s["n_rejoins"])]),
+            (f"{p}_handoffs_total", "counter",
+             [(None, s["n_handoffs"])]),
+            (f"{p}_spawns_total", "counter", [(None, s["n_spawns"])]),
+            (f"{p}_retires_total", "counter", [(None, s["n_retires"])]),
+            (f"{p}_replica_seconds", "gauge",
+             [(None, s["replica_seconds"])]),
+            (f"{p}_dispatched_total", "counter", [
+                ({"replica": name}, n)
+                for name, n in sorted(s["dispatched"].items())
+            ]),
+            (f"{p}_slot_occupancy", "gauge",
+             [(None, s["slot_occupancy"])]),
+            (f"{p}_aggregate_tokens_per_sec", "gauge",
+             [(None, s["aggregate_tokens_per_sec"])]),
+            (f"{p}_replica_tokens_per_sec", "gauge", [
+                ({"replica": name}, v["tokens_per_sec"])
+                for name, v in sorted(per.items())
+            ]),
+            (f"{p}_replica_slot_occupancy", "gauge", [
+                ({"replica": name}, v["slot_occupancy"])
+                for name, v in sorted(per.items())
+            ]),
+        ])

@@ -388,6 +388,13 @@ class Supervisor:
     elastic_min_dp: int = 1
     n_devices: Optional[int] = None      # baseline world (elastic)
     world_file: Optional[str] = None     # default {ckpt}/.world
+    # span tracing (theanompi_tpu/obs): when a Tracer is attached the
+    # whole supervised run is ONE always-sampled trace — a "life"
+    # span per (re)launch (spawn → death/completion, with cause,
+    # exit code, progress, and the elastic world) under a
+    # "supervised_run" root, so restart storms read as lanes in the
+    # same Perfetto export the serving fleet uses.
+    tracer: Optional[object] = None
 
     events: list = field(default_factory=list, init=False)
     proc: Optional[subprocess.Popen] = field(default=None, init=False)
@@ -531,6 +538,12 @@ class Supervisor:
         cause: str | None = None
         t_fail: float | None = None
         pending: RestartEvent | None = None  # awaiting recovery proof
+        self._trace_ctx = self._run_root = None
+        if self.tracer is not None:
+            self._trace_ctx = self.tracer.new_context(force=True)
+            self._run_root = self.tracer.start_span(
+                self._trace_ctx, "supervised_run"
+            )
 
         while True:
             if self.elastic:
@@ -549,6 +562,9 @@ class Supervisor:
                     )
             _, last_hb_time, _ = self._read_hb()
             self.proc = self._spawn(resume, restart, cause, t_fail)
+            t_launch_tr = (
+                self.tracer.clock() if self.tracer is not None else 0.0
+            )
             t_launch = time.monotonic()
             last_beat = t_launch
             seen_beat_this_run = False
@@ -598,6 +614,18 @@ class Supervisor:
             progress, _, hb = self._read_hb()
             hb_status = (hb or {}).get("status")
             cause = "hang" if hang else classify_exit(rc, hb_status)
+            if self.tracer is not None:
+                self.tracer.record_span(
+                    self._trace_ctx, "life", t_launch_tr,
+                    self.tracer.clock(),
+                    parent_id=self._run_root["span_id"],
+                    lane="supervisor", life=restart, cause=cause,
+                    exit_code=None if hang else rc,
+                    progress=max(progress, 0),
+                    world_size=(self.world_history[-1]
+                                if self.elastic and self.world_history
+                                else None),
+                )
             # last stamp before death may carry the resume point
             self._fold_hb_into_last_event(hb)
             pending = None  # died before proving recovery: unset
@@ -661,6 +689,10 @@ class Supervisor:
             resume = True
 
     def _report(self, completed: bool, final_hb: dict | None) -> dict:
+        if self.tracer is not None and \
+                getattr(self, "_run_root", None) is not None:
+            self.tracer.end_span(self._run_root, completed=completed)
+            self._run_root = None
         recoveries = [
             e.recovery_s for e in self.events if e.recovery_s is not None
         ]

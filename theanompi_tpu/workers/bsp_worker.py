@@ -273,6 +273,21 @@ def run(
     recorder = Recorder(
         rank=0, size=n_replicas, print_freq=print_freq, verbose=verbose
     )
+    # span tracing (theanompi_tpu/obs, config knob "trace"): each
+    # sampled iteration becomes one trace — load/step/exchange phase
+    # spans riding the iteration-boundary heartbeat below; dump with
+    # config["trace_export"] = path (Perfetto-openable JSON)
+    tracer = None
+    if cfg.get("trace"):
+        from theanompi_tpu.obs import Tracer
+
+        tracer = Tracer(
+            process="bsp_worker",
+            sample=int(cfg.get("trace_sample", 1)),
+        )
+        recorder.attach_tracer(tracer)
+        recorder.trace_boundary()   # labels default to n_iter —
+        # cumulative recorded iterations, correct across resumes
     # graceful preemption: SIGTERM → checkpoint at the next iteration
     # boundary (meta stamps next_iter) and exit 0 — a planned
     # preemption loses zero steps instead of the whole epoch
@@ -362,6 +377,7 @@ def run(
             _faults.maybe_inject_fault(epoch, i - k, i - 1,
                                        checkpoint_dir=checkpoint_dir,
                                        world=n_devices)
+            recorder.trace_boundary()
             _sup.heartbeat(recorder.n_iter, epoch, i - 1,
                            resumed_from=resumed_from,
                            world_size=n_replicas,
@@ -417,6 +433,18 @@ def run(
     # give an in-process host its normal SIGTERM semantics back
     _sup.uninstall_preemption_handler()
 
+    trace_spans = None
+    if tracer is not None:
+        recorder.finish_trace()
+        trace_spans = tracer.stats()["n_spans"]
+        if cfg.get("trace_export"):
+            from theanompi_tpu.obs import write_chrome_trace
+
+            write_chrome_trace(tracer.spans(), cfg["trace_export"])
+            if verbose:
+                print(f"trace: {trace_spans} spans -> "
+                      f"{cfg['trace_export']}", flush=True)
+
     last_val = recorder.val_records[-1] if recorder.val_records else {}
     return {
         "epochs": model.epoch,
@@ -444,6 +472,7 @@ def run(
         ),
         "elastic_resume": elastic_note,
         "resharded": bool(resharded),
+        "trace_spans": trace_spans,
         "recorder": recorder,
         "model": model,
     }
