@@ -34,42 +34,26 @@ from pathlib import Path
 
 REPO = Path(__file__).resolve().parent
 
-# peak dense bf16 FLOP/s per chip, by PJRT device_kind
-PEAK_BF16 = {
-    "TPU v2": 45e12,
-    "TPU v3": 123e12,
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,
-    "TPU v5e": 197e12,
-    "TPU v5": 459e12,
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,
-    "TPU v6e": 918e12,
-}
-
-
 def _peak_flops(devices) -> float | None:
-    kind = getattr(devices[0], "device_kind", "")
-    for name, peak in PEAK_BF16.items():
-        if kind.startswith(name):
-            return peak
-    return None
+    """Datasheet bf16 peak per chip — the table moved to
+    ``scaling_model.PEAK_BF16`` so the step-phase profiler and the
+    bench share one MFU denominator."""
+    from theanompi_tpu.utils.scaling_model import peak_flops_per_chip
+
+    return peak_flops_per_chip(devices)
 
 
 def _step_flops(model, n_devices: int) -> float | None:
     """TOTAL FLOPs of one train step across all devices, from the
-    model's ACTIVE step (``train_step_cost_analysis``).
+    model's ACTIVE step (``train_step_cost_analysis``) — the
+    list-vs-dict API normalization lives in ONE place,
+    ``scaling_model.cost_analysis_totals``."""
+    from theanompi_tpu.utils.scaling_model import cost_analysis_totals
 
-    XLA's ``cost_analysis()`` dict reports the PER-DEVICE partitioned
-    module (verified on this image: a 4-way-sharded 4.19M-FLOP matmul
-    reports 1.05M), so the dict branch scales by ``n_devices``; the
-    old list API is one dict per partition and sums to the total."""
     try:
-        ca = model.train_step_cost_analysis()
-        if isinstance(ca, list):
-            flops = sum(float(d.get("flops", 0.0)) for d in ca)
-        else:
-            flops = float(ca.get("flops", 0.0)) * n_devices
+        flops, _ = cost_analysis_totals(
+            model.train_step_cost_analysis(), n_devices
+        )
         return flops if flops > 0 else None
     except Exception:
         return None
@@ -2394,6 +2378,286 @@ def bench_serving_autoscale() -> dict:
     return result
 
 
+_PROFILE_CHILD = r"""
+import json, os, statistics, sys, time
+sys.path.insert(0, os.environ["TM_REPO"])
+import jax
+jax.config.update("jax_default_device", jax.devices("cpu")[0])
+from theanompi_tpu.parallel import make_mesh
+from theanompi_tpu.utils import Recorder
+from theanompi_tpu.utils import scaling_model as sm
+from theanompi_tpu.obs import chrome_trace, step_profile
+
+smoke = os.environ.get("TM_PROFILE_SMOKE") == "1"
+devs = jax.devices("cpu")[:8]
+# the CPU-mesh MFU absolute is meaningless, so every figure uses the
+# v5e peak as a CONSISTENT denominator — the judged data are the
+# decomposition (coverage, per-bucket legs) and the INTERNAL
+# consistency of the profile's MFU with the same run's rate-derived
+# figure, not the absolute
+PEAK = sm.V5E.peak_bf16
+
+def build_llama():
+    from theanompi_tpu.models.llama import Llama
+    K, B, T = 10, 2, 256
+    cfg = dict(dim=128, n_layers=2, n_heads=8, n_kv_heads=4,
+               ffn_dim=352, vocab=2048, seq_len=T, batch_size=B,
+               lr=1e-3, seed=11, compute_dtype="float32",
+               device_data_cache=True, steps_per_call=K,
+               n_train=K * B * 8, n_val=8, exch_strategy="asa32",
+               exchange_bucket_mb=0.25)
+    m = Llama(cfg)
+    m.build_model(n_replicas=8)
+    m.compile_iter_fns(mesh=make_mesh(data=8, devices=devs))
+    return m, K, B * 8 * T
+
+def build_googlenet():
+    from theanompi_tpu.models.googlenet import GoogLeNet
+    # crop=96 (not 224): XLA:CPU traces convolutions at eigen-task
+    # granularity, so a full-size GoogLeNet step emits a multi-GB
+    # xspace (observed 3.3 GB — past the 2 GB protobuf cap); the
+    # decomposition is shape-independent, the small crop keeps the
+    # trace parseable
+    K, B = 2, 1
+    cfg = dict(batch_size=B, n_train=K * B * 8, n_val=8, crop=96,
+               device_data_cache=True, steps_per_call=K,
+               exchange_bucket_mb=1)
+    m = GoogLeNet(cfg)
+    m.build_model(n_replicas=8)
+    m.compile_iter_fns(mesh=make_mesh(data=8, devices=devs),
+                       exch_strategy="asa32")
+    return m, K, B * 8
+
+def step_flops_of(m):
+    return sm.cost_analysis_totals(m.train_step_cost_analysis(), 8)
+
+def profile_model(name, build, n_windows, mfu_floor=0.5):
+    m, K, units_per_step = build()
+    rec = Recorder(verbose=False)
+    def window():
+        m.train_chunk(0, K, rec); rec.flush()
+    window()                                     # compile
+    window()                                     # warm
+    hlo = m.train_step_hlo_text()
+    flops, byts = step_flops_of(m)
+
+    def timed_windows():
+        walls = []
+        for _ in range(n_windows):
+            t0 = time.perf_counter()
+            window()
+            walls.append(time.perf_counter() - t0)
+        return walls
+
+    before = timed_windows()                     # unprofiled
+    # pack bytes for the scaling-model prediction the gap is judged
+    # against (fp32 masters; the proxy's own parameter tree)
+    import numpy as np
+    n_params = sum(int(np.prod(np.shape(x)))
+                   for x in jax.tree.leaves(m.params))
+    bucket_mb = float(m.config.get("exchange_bucket_mb") or 0)
+    predicted = sm.bucketed_overlap(
+        wire_bytes=4.0 * n_params, n_chips=8,
+        step_time_1chip=statistics.median(before) / K,
+        bucket_bytes=bucket_mb * 2**20,
+    )
+    prof = step_profile(
+        window, hlo_text=hlo, n_steps=K, n_devices=8, name=name,
+        peak_flops=PEAK, step_flops=flops, step_bytes=byts,
+        predicted=predicted,
+    )
+    d = prof.as_dict()
+    # the bench-row-style MFU from the same child's UNPROFILED rate —
+    # the consistency bar for the profile's own traced-window figure
+    step_s = statistics.median(before) / K
+    d["row_mfu"] = flops / (step_s * 8 * PEAK) if flops else None
+    d["mfu_ratio_vs_row"] = (
+        d["measured_mfu"] / d["row_mfu"]
+        if d["measured_mfu"] and d["row_mfu"] else None
+    )
+    # CPU-thunk tracing cost on the TRACED window itself (TPU device
+    # planes are hardware-traced, ~free; XLA:CPU conv thunks trace at
+    # eigen-task granularity, observed ~20x on GoogLeNet — which is
+    # why the strict MFU-consistency bar rides the Llama arm here and
+    # conv models on THIS backend only report the ratio)
+    d["trace_overhead"] = d["step_s"] / step_s
+    d["walls_before"] = before
+    d["n_exchange_legs"] = sum(
+        1 for k in d["legs"] if k.startswith("exchange_b")
+    )
+    # in-child acceptance asserts (ISSUE 15): the decomposition SUMS
+    # (coverage leg included), the exchange decomposed per bucket,
+    # the optimizer leg exists, the gap is attributed to named legs,
+    # and the profile's MFU is consistent with the row-style figure
+    assert abs(d["coverage"] - 1.0) <= 0.05, d["coverage"]
+    assert d["n_exchange_legs"] >= 2, sorted(d["legs"])
+    assert "optimizer" in d["legs"], sorted(d["legs"])
+    assert d["gap"] is not None and abs(
+        d["gap"]["coverage"] - 1.0) <= 0.05, d["gap"]
+    assert d["mfu_ratio_vs_row"] is not None \
+        and mfu_floor <= d["mfu_ratio_vs_row"] <= 1.5, \
+        (d["mfu_ratio_vs_row"], d["trace_overhead"])
+    return prof, d, window
+
+out = {}
+profs = []
+n_windows = 2 if smoke else 3
+# llama holds the strict MFU-consistency bar (its matmul thunks
+# trace cheaply even on CPU); googlenet's floor covers this
+# backend's conv-tracing inflation — on TPU both run the 0.5 bar
+models = [("llama_proxy", build_llama, 0.5)]
+if not smoke:
+    models.append(("googlenet", build_googlenet, 0.02))
+llama_window = None
+for name, build, mfu_floor in models:
+    prof, d, window = profile_model(name, build, n_windows,
+                                    mfu_floor=mfu_floor)
+    profs.append(prof)
+    out[name] = d
+    if name == "llama_proxy":
+        llama_window = window
+
+# profiler-overhead bar (the PR 12 tracing-overhead protocol,
+# interleaved repeats + medians so cross-minute host drift cancels —
+# same-invocation window spreads on this 2-core container run 3-5%,
+# past a naive before/after 2% bound): each repeat times a plain
+# window, runs a profile CAPTURE, then times the next plain window.
+# The claim under test: the named scopes are free and a capture
+# leaves no residue on the timed path.
+import tempfile
+from theanompi_tpu.utils import trace_comm
+
+bound = 1.10 if smoke else 1.02
+walls_off, walls_on = [], []
+for _ in range(2 if smoke else 4):
+    t0 = time.perf_counter()
+    llama_window()
+    walls_off.append(time.perf_counter() - t0)
+    with tempfile.TemporaryDirectory() as td:
+        trace_comm.capture_trace(llama_window, td)
+    t0 = time.perf_counter()
+    llama_window()
+    walls_on.append(time.perf_counter() - t0)
+overhead = statistics.median(walls_on) / statistics.median(walls_off)
+assert overhead < bound, (walls_on, walls_off)
+out["profiler_overhead"] = {
+    "bound": bound,
+    "worst_ratio": overhead,
+    "walls_unprofiled": walls_off,
+    "walls_post_capture": walls_on,
+}
+
+# one-view export: every profile's phase tree + counter tracks render
+# through the SAME chrome_trace the request traces use — parse-proven
+spans, counters = [], []
+for p in profs:
+    spans += p.spans()
+    counters += p.counter_tracks()
+ct = chrome_trace(spans, counters=counters)
+json.dumps(ct)
+out["export_events"] = len(ct["traceEvents"])
+print("PROFILE " + json.dumps(out))
+"""
+
+
+def bench_profile() -> dict:
+    """Step-phase profiler row (ISSUE 15): StepProfile decompositions
+    for the Llama proxy AND GoogLeNet on the 8-dev CPU mesh — the
+    machinery ROADMAP 3a/3b need to retire their levers with (a
+    profiled per-bucket decomposition proving a gap is geometry).
+
+    In-child asserted: per-scope times sum to the measured step
+    within 5% (coverage leg included), the exchange decomposes per
+    bucket, the optimizer leg exists, the gap attribution covers the
+    step, the profile's MFU is consistent with the same run's
+    rate-derived row figure, and a profiled child's timed windows
+    stay within the overhead bound of unprofiled ones (the PR 12
+    tracing-overhead protocol)."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.update(
+        TM_REPO=str(REPO),
+        TM_TPU_PLATFORM="cpu",
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+        PALLAS_AXON_POOL_IPS="",
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", _PROFILE_CHILD],
+        env=env, capture_output=True, text=True, timeout=2400,
+    )
+    rec = None
+    for line in out.stdout.splitlines():
+        if line.startswith("PROFILE "):
+            rec = json.loads(line[len("PROFILE "):])
+    if rec is None:
+        raise RuntimeError(
+            f"profile child produced no result:\n"
+            f"{out.stdout[-1500:]}\n{out.stderr[-1500:]}"
+        )
+
+    def round_tree(d):
+        return {
+            k: (round(v, 6) if isinstance(v, float)
+                else round_tree(v) if isinstance(v, dict)
+                else v)
+            for k, v in d.items()
+        }
+
+    head = rec.get("llama_proxy", {})
+    result = {
+        "metric": (
+            "step-phase profiler coverage (per-scope decomposition: "
+            "compute/exchange-per-bucket/optimizer/host, Llama proxy "
+            "+ GoogLeNet, 8-dev CPU mesh)"
+        ),
+        "value": round(head.get("coverage", 0.0), 4),
+        "unit": "coverage_frac",
+        "vs_baseline": None,
+        "profiler_overhead": round_tree(rec.get("profiler_overhead",
+                                                {})),
+        "export_events": rec.get("export_events"),
+    }
+    for name in ("llama_proxy", "googlenet"):
+        if name not in rec:
+            continue
+        d = rec[name]
+        result[name] = round_tree({
+            "step_s": d["step_s"],
+            "coverage": d["coverage"],
+            "n_exchange_legs": d["n_exchange_legs"],
+            "measured_mfu": d["measured_mfu"],
+            "row_mfu": d["row_mfu"],
+            "mfu_ratio_vs_row": d["mfu_ratio_vs_row"],
+            "trace_overhead": d["trace_overhead"],
+            "exposed_comm_s": d["exposed_comm_s"],
+            "legs": {
+                leg: {
+                    k: v[k] for k in ("time_s", "comm_s", "mfu",
+                                      "intensity")
+                    if v.get(k) is not None
+                }
+                for leg, v in d["legs"].items()
+            },
+            "gap": d["gap"],
+        })
+    result["scale_note"] = (
+        "XLA:CPU mesh — absolute MFU uses the v5e peak as a "
+        "consistent denominator, so only the DECOMPOSITION "
+        "(coverage, per-bucket legs, gap attribution) and the "
+        "internal MFU consistency are judged; the strict "
+        "MFU-vs-row bar rides the llama arm because XLA:CPU traces "
+        "convolutions at eigen-task granularity (googlenet's traced "
+        "window inflates ~20x — trace_overhead reports it; TPU "
+        "device planes are hardware-traced, so on chip both arms "
+        "hold the bar).  docs/PERFORMANCE.md: reading a StepProfile"
+    )
+    return result
+
+
 def bench_easgd() -> dict:
     """BASELINE config 3: WRN-28-10 under the EASGD rule's exchange
     cadence, on the real chip — the async rules' first captured COST
@@ -2748,6 +3012,7 @@ BENCHES = {
     "zero1": lambda **kw: bench_zero1(),
     "bucketed": lambda **kw: bench_bucketed(),
     "compressed": lambda **kw: bench_compressed(),
+    "profile": lambda **kw: bench_profile(),
     "serving": lambda **kw: bench_serving(),
     "serving_paged": lambda **kw: bench_serving_paged(),
     "serving_fleet": lambda **kw: bench_serving_fleet(),
@@ -2766,21 +3031,43 @@ def _headline_line(rec: dict) -> str:
     with it the whole record.  This compact single line is printed
     LAST: whatever else is cut, the judged numbers survive.  One
     number + vs_baseline per bench; secondary errors collapse to a
-    short string."""
+    short string.
+
+    ``regress`` (ISSUE 15): the record judges ITSELF against the
+    newest on-disk ``BENCH_*`` capture through the trajectory gate's
+    spread-aware verdicts (``obs/regress.judge_record``) — so a
+    capture is self-flagging even when ``scripts/bench_diff.py``
+    never runs on it.  Diagnostic, never fatal: a broken history
+    yields ``{"verdict": "unknown"}``."""
     compact = {
         k: rec.get(k) for k in ("metric", "value", "unit", "vs_baseline")
     }
     sec = rec.get("secondary")
     if sec:
+        # unit + spread ride along: a tail-salvaged capture feeds
+        # these rows straight to the regression gate, whose verdict
+        # DIRECTION comes from the unit (a lower-better row judged
+        # unit-less would read a slowdown as an improvement) and
+        # whose noise band reads the spread
         compact["secondary"] = {
             name: (
                 {"value": row.get("value"),
-                 "vs_baseline": row.get("vs_baseline")}
+                 "vs_baseline": row.get("vs_baseline"),
+                 "unit": row.get("unit"),
+                 **({"spread": row["spread"]}
+                    if row.get("spread") is not None else {})}
                 if "error" not in row else
                 {"error": str(row["error"])[:120]}
             )
             for name, row in sec.items()
         }
+    try:
+        from theanompi_tpu.obs.regress import judge_record
+
+        compact["regress"] = judge_record(rec, REPO)
+    except Exception as e:  # pragma: no cover - defensive
+        compact["regress"] = {"verdict": "unknown",
+                              "error": str(e)[:120]}
     return "BENCH_HEADLINE " + json.dumps(compact)
 
 
@@ -2812,8 +3099,8 @@ def main() -> None:
     # vgg16/googlenet joined the default list with PR 7 (ROADMAP 4c
     # leftover); serving_fleet is the multi-replica router row
     for name in ("wresnet", "llama", "alexnet", "vgg16", "googlenet",
-                 "zero1", "bucketed", "compressed", "serving",
-                 "serving_paged", "serving_fleet",
+                 "zero1", "bucketed", "compressed", "profile",
+                 "serving", "serving_paged", "serving_fleet",
                  "serving_autoscale", "loader",
                  "loader_train", "easgd", "gosgd"):
         # two attempts: the tunneled remote-compile service drops a

@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Bench-trajectory diff + regression gate (ISSUE 15).
+
+Human mode prints one line per bench row: newest capture vs the
+nearest prior capture carrying the row vs the baseline, with the
+spread-aware verdict (``theanompi_tpu/obs/regress.py`` — a row flags
+only when its adverse move exceeds its own noise band: recorded
+window spreads, the row's accepted trajectory variability, and the
+cross-invocation floor).
+
+``--gate`` prints the same verdicts compactly and exits nonzero on a
+confirmed regression in the newest capture — the CI hook
+(``scripts/bench_smoke.sh`` runs it green over the real trajectory).
+
+Usage::
+
+    python scripts/bench_diff.py [--repo DIR] [--gate] [--json]
+    python scripts/bench_diff.py --capture rec.json   # judge a file
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from theanompi_tpu.obs import regress  # noqa: E402
+
+
+def _fmt_val(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.4g}" if abs(v) < 100 else f"{v:,.1f}"
+    return str(v)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="diff the BENCH_* capture trajectory; --gate "
+                    "exits 1 on a confirmed regression"
+    )
+    ap.add_argument("--repo", default=str(REPO),
+                    help="directory holding the BENCH_*.json captures")
+    ap.add_argument("--gate", action="store_true",
+                    help="exit 1 when the newest capture regressed")
+    ap.add_argument("--json", action="store_true",
+                    help="print the verdict record as JSON")
+    ap.add_argument("--capture", default=None,
+                    help="judge this bench-record JSON file (one "
+                         "bench.py output line) against the on-disk "
+                         "history instead of the newest capture")
+    args = ap.parse_args(argv)
+
+    history = regress.load_history(args.repo)
+    if not history:
+        print(f"bench_diff: no BENCH_*.json under {args.repo}",
+              file=sys.stderr)
+        return 2
+
+    cur = None
+    if args.capture:
+        try:
+            rec = json.loads(Path(args.capture).read_text())
+        except (OSError, ValueError) as e:
+            print(f"bench_diff: cannot read {args.capture}: {e}",
+                  file=sys.stderr)
+            return 2
+        cur = regress.record_to_capture(
+            rec, name=Path(args.capture).stem
+        )
+    judged = regress.judge_capture(history, cur)
+
+    if args.json:
+        print(json.dumps(judged, indent=1, sort_keys=True))
+    else:
+        names = [c["name"] for c in history] + (
+            [cur["name"]] if cur else []
+        )
+        print(
+            f"trajectory: {' -> '.join(names)}  "
+            f"(newest judged: {judged['capture']})"
+        )
+        hist_all = history + ([cur] if cur else [])
+        aligned = regress.align_rows(hist_all)
+        base = hist_all[0]["rows"]
+        print(f"{'row':20s} {'baseline':>10s} {'prev':>12s} "
+              f"{'now':>12s} {'ratio':>7s} {'band':>6s}  verdict")
+        for name, v in sorted(judged["rows"].items()):
+            series = aligned[name]
+            base_v = (base.get(name) or {}).get("value")
+            print(
+                f"{name:20s} {_fmt_val(base_v):>10s} "
+                f"{_fmt_val(v.get('prev')):>12s} "
+                f"{_fmt_val(v.get('value')):>12s} "
+                f"{_fmt_val(v.get('ratio')):>7s} "
+                f"{_fmt_val(v.get('band')):>6s}  "
+                f"{v['verdict']}"
+                + (f"  (vs {v['vs']})" if v.get("vs") else "")
+            )
+    if judged["regressed"]:
+        print(
+            f"bench_diff: REGRESSED beyond noise band: "
+            f"{', '.join(judged['regressed'])}",
+            file=sys.stderr,
+        )
+    if args.gate:
+        return 1 if judged["regressed"] else 0
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

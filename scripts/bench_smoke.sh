@@ -39,6 +39,15 @@
 #    autoscaler; asserts ≥1 scale-up AND ≥1 drained scale-down with
 #    every request completing under exact token accounting (zero
 #    dropped across the membership changes), SLOs held.
+# 7. profile + regression gate (ISSUE 15): the step-phase profiler
+#    row in smoke shape (Llama proxy only) — asserts the per-scope
+#    decomposition sums (coverage within 5%), the exchange
+#    decomposed per bucket, and a PROFILED child's timed windows
+#    stay within the overhead bound of unprofiled ones (PR 12's
+#    tracing-overhead protocol; smoke bound proportionally looser
+#    than the full row's 2% — ~1 s windows on a 2-core host are
+#    scheduler-noise-bound).  Then `bench_diff --gate` must run
+#    GREEN over the repo's real BENCH_* trajectory.
 #
 # Usage: bash scripts/bench_smoke.sh
 
@@ -229,3 +238,34 @@ if not (auto["n_spawns"] >= 2 and auto["n_retires"] >= 1):
              "scale-down: %s" % auto)
 print("bench_smoke: serving_autoscale OK")
 '
+
+# 7. step-phase profiler smoke + trajectory regression gate
+out=$(TM_PROFILE_SMOKE=1 TM_BENCH_MODEL=profile python bench.py)
+printf '%s\n' "$out" | python -c '
+import json, sys
+row = json.loads(sys.stdin.readline())
+prof = row.get("llama_proxy") or {}
+ov = row.get("profiler_overhead") or {}
+print("profile coverage", prof.get("coverage"),
+      "exchange legs", prof.get("n_exchange_legs"),
+      "overhead", ov.get("worst_ratio"), "bound", ov.get("bound"))
+if not prof:
+    sys.exit("bench_smoke: profile row carried no llama_proxy "
+             "decomposition: %s" % sorted(row))
+if not abs(prof["coverage"] - 1.0) <= 0.05:
+    sys.exit("bench_smoke: per-scope times do not sum to the step "
+             "(coverage %s)" % prof["coverage"])
+if not prof["n_exchange_legs"] >= 2:
+    sys.exit("bench_smoke: exchange not decomposed per bucket: %s"
+             % prof)
+if not (ov and ov["worst_ratio"] < ov["bound"]):
+    sys.exit("bench_smoke: profiled child wall past the overhead "
+             "bound: %s" % ov)
+if not (prof.get("gap") or {}).get("legs"):
+    sys.exit("bench_smoke: gap attribution missing named legs: %s"
+             % prof.get("gap"))
+print("bench_smoke: profile OK")
+'
+
+python scripts/bench_diff.py --gate
+echo "bench_smoke: bench_diff --gate OK"

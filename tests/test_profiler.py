@@ -1,0 +1,450 @@
+"""Step-phase profiler (ISSUE 15 tentpole a+c:
+``theanompi_tpu/obs/profiler.py`` + the counter-track export).
+
+Fast tier covers the host-side machinery — scope-set extraction from
+HLO text, leg assembly/coverage/gap math on hand-built profiles, the
+single-view Chrome-trace export (profile spans + counter tracks next
+to request spans), and the new ``tm_train_*`` metrics text.  The
+slow tier captures a REAL device trace through a tiny model and
+through the BSP worker's ``step_profile`` config knob."""
+
+import json
+from collections import OrderedDict
+
+import pytest
+
+from theanompi_tpu.obs import chrome_trace
+from theanompi_tpu.obs.profiler import (
+    StepProfile,
+    format_profile,
+    gap_attribution,
+    profile_scope_sets,
+)
+
+# synthetic optimized-HLO text: instruction metadata in the exact
+# shape `scope_op_names`'s regex matches on this image
+_HLO = """
+  %fusion.1 = f32[8]{0} fusion(...), metadata={op_name="jit(step)/fwd/dot_general"}
+  %reduce-scatter.1 = f32[4]{0} reduce-scatter(...), metadata={op_name="jit(step)/exchange_b0/psum_scatter"}
+  %all-gather.1 = f32[8]{0} all-gather(...), metadata={op_name="jit(step)/exchange_b0/all_gather"}
+  %reduce-scatter.2 = f32[4]{0} reduce-scatter(...), metadata={op_name="jit(step)/exchange_b1/psum_scatter"}
+  %fusion.7 = f32[4]{0} fusion(...), metadata={op_name="jit(step)/exchange_b0/quantize_wire/mul"}
+  %fusion.8 = f32[4]{0} fusion(...), metadata={op_name="jit(step)/exchange_b1/dequantize_wire/convert"}
+  %fusion.9 = f32[4]{0} fusion(...), metadata={op_name="jit(step)/opt_update/adam/mul"}
+  %fusion.12 = f32[4]{0} fusion(...), metadata={op_name="jit(step)/exchange_b12/psum_scatter"}
+  %fusion.13 = f32[8]{0} fusion(...), metadata={op_name="jit(step)/serving_sample/gumbel"}
+"""
+
+
+class TestScopeSets:
+    def test_legs_extracted_and_grouped(self):
+        sets = profile_scope_sets(_HLO)
+        # both codec halves group under ONE quantize leg
+        assert sets["quantize"] == {"fusion.7", "fusion.8"}
+        assert sets["optimizer"] == {"fusion.9"}
+        assert sets["exchange_b0"] == {"reduce-scatter.1",
+                                       "all-gather.1"}
+        assert sets["exchange_b1"] == {"reduce-scatter.2"}
+        assert sets["exchange_b12"] == {"fusion.12"}
+        assert sets["sample"] == {"fusion.13"}
+        # the unscoped fwd fusion belongs to no leg
+        assert not any("fusion.1" in s for s in sets.values())
+
+    def test_exact_legs_precede_bucket_legs(self):
+        """First-match-wins attribution: a nested
+        exchange_b0/quantize_wire op must land in quantize, so the
+        quantize leg is ordered BEFORE every exchange bucket."""
+        names = list(profile_scope_sets(_HLO))
+        assert names.index("quantize") < names.index("exchange_b0")
+
+    def test_bucket_order_numeric(self):
+        names = [n for n in profile_scope_sets(_HLO)
+                 if n.startswith("exchange_b")]
+        assert names == ["exchange_b0", "exchange_b1", "exchange_b12"]
+
+    def test_empty_hlo(self):
+        assert profile_scope_sets("") == OrderedDict()
+
+
+def _mk_profile(*, step_s=0.100, n_steps=10, n_devices=8, n_cores=8,
+                flops=1e9, peak=1e12):
+    """Hand-built StepProfile with a known decomposition: 60 ms
+    compute, 10 ms exchange (8 exposed), 5 ms optimizer, 25 ms host
+    gap."""
+    legs = OrderedDict()
+    legs["compute"] = {"time_s": 0.060, "core_s": 0.060 * 80,
+                       "flops": flops,
+                       "mfu": flops / (0.060 * n_devices * peak)}
+    legs["exchange_b0"] = {"time_s": 0.010, "core_s": 0.010 * 80,
+                           "comm_s": 0.010}
+    legs["optimizer"] = {"time_s": 0.005, "core_s": 0.005 * 80}
+    legs["host_gap"] = {"time_s": 0.025, "core_s": 0.025}
+    return StepProfile(
+        name="toy", n_steps=n_steps, n_devices=n_devices,
+        n_cores=n_cores, step_s=step_s, device_busy_s=0.075 * 80,
+        legs=legs, exposed_comm_s=0.008, collective_s=0.010,
+        peak_flops=peak, step_flops=flops,
+        measured_mfu=flops / (step_s * n_devices * peak),
+    )
+
+
+class TestStepProfileMath:
+    def test_coverage_sums_to_one(self):
+        assert abs(_mk_profile().coverage - 1.0) < 1e-9
+
+    def test_gap_attribution_named_legs_cover_the_step(self):
+        p = _mk_profile()
+        gap = gap_attribution(p)
+        ideal = 1e9 / (8 * 1e12)
+        assert abs(gap["ideal_step_s"] - ideal) < 1e-12
+        # geometry = compute beyond ideal; every named leg + ideal
+        # reassembles the measured step (the decomposition property)
+        assert abs(gap["legs"]["geometry_s"] - (0.060 - ideal)) < 1e-9
+        assert gap["legs"]["exposed_comm_s"] == 0.008
+        assert gap["legs"]["optimizer_s"] == 0.005
+        assert gap["legs"]["host_s"] == 0.025
+        total = gap["ideal_step_s"] + sum(gap["legs"].values())
+        # exchange time is counted by its EXPOSED share (hidden comm
+        # never extends the wall) — the 2 ms hidden here is the only
+        # tolerated slack
+        assert abs(total - p.step_s) <= 0.002 + 1e-9
+
+    def test_gap_none_without_flops(self):
+        p = _mk_profile()
+        p.step_flops = None
+        assert gap_attribution(p) is None
+
+    def test_predicted_row_carried(self):
+        gap = gap_attribution(
+            _mk_profile(),
+            predicted={"t_exposed_ms": 7.5, "mfu": 0.4},
+        )
+        assert gap["predicted_exposed_comm_s"] == 0.0075
+        assert gap["predicted_mfu"] == 0.4
+
+    def test_as_dict_json_able(self):
+        p = _mk_profile()
+        p.gap = gap_attribution(p)
+        json.dumps(p.as_dict())
+
+    def test_format_profile_renders(self):
+        p = _mk_profile()
+        p.gap = gap_attribution(p)
+        txt = format_profile(p)
+        assert "compute" in txt and "host_gap" in txt
+        assert "geometry_s" in txt
+
+
+class TestSingleViewExport:
+    def test_profile_spans_are_connected_and_serial(self):
+        spans = _mk_profile().spans(t0=1000.0)
+        root = spans[0]
+        kids = spans[1:]
+        assert root["name"] == "step_profile:toy"
+        assert all(k["parent_id"] == root["span_id"] for k in kids)
+        # legs lay out serially inside the root interval
+        for a, b in zip(kids, kids[1:]):
+            assert abs(a["t1"] - b["t0"]) < 1e-9
+        assert abs(kids[-1]["t1"] - root["t1"]) < 1e-9
+
+    def test_counter_tracks_shape(self):
+        tracks = _mk_profile().counter_tracks(t=1000.0)
+        names = {t["name"] for t in tracks}
+        assert "step_phase_s:toy" in names and "mfu:toy" in names
+        phase = next(t for t in tracks
+                     if t["name"] == "step_phase_s:toy")
+        assert set(phase["values"]) == {"compute", "exchange_b0",
+                                        "optimizer", "host_gap"}
+
+    def test_chrome_trace_one_view(self):
+        """Profile spans + counter tracks + request-trace spans render
+        through ONE chrome_trace call — counter events as "ph": "C"
+        under their process lane, span events untouched (tentpole
+        c)."""
+        prof = _mk_profile()
+        req_spans = [{
+            "trace_id": 7, "span_id": 8, "parent_id": None,
+            "name": "request", "t0": 1000.0, "t1": 1000.2,
+            "process": "router", "lane": "router", "attrs": {},
+        }]
+        counters = prof.counter_tracks(t=1000.05) + [
+            {"process": "serving", "name": "slots", "t": 1000.1,
+             "values": {"active_slots": 3, "queue_depth": 1}},
+        ]
+        doc = chrome_trace(req_spans + prof.spans(t0=1000.0),
+                           counters=counters)
+        evs = doc["traceEvents"]
+        phases = {e["ph"] for e in evs}
+        assert {"X", "C", "M"} <= phases
+        procs = {
+            e["args"]["name"] for e in evs
+            if e["ph"] == "M" and e["name"] == "process_name"
+        }
+        assert {"router", "profiler", "serving"} <= procs
+        counter_evs = [e for e in evs if e["ph"] == "C"]
+        assert any(e["name"] == "slots" for e in counter_evs)
+        assert any(e["name"].startswith("step_phase_s")
+                   for e in counter_evs)
+        json.dumps(doc)
+
+    def test_counter_none_values_dropped(self):
+        doc = chrome_trace([], counters=[
+            {"process": "p", "name": "g", "t": 1.0,
+             "values": {"a": 1, "b": None}},
+        ])
+        c = next(e for e in doc["traceEvents"] if e["ph"] == "C")
+        assert c["args"] == {"a": 1}
+
+
+class TestServingCounterTracks:
+    def test_record_step_stamps_wall_time(self):
+        from theanompi_tpu.utils.recorder import ServingRecorder
+
+        r = ServingRecorder(max_slots=4)
+        r.record_step(active_slots=2, queue_depth=1, dt_s=0.01,
+                      tokens=2, blocks_in_use=5, blocks_free=3)
+        tracks = r.counter_tracks(process="r0")
+        assert len(tracks) == 2
+        slots = next(t for t in tracks if t["name"] == "slots")
+        assert slots["values"] == {"active_slots": 2, "queue_depth": 1}
+        blocks = next(t for t in tracks if t["name"] == "kv_blocks")
+        assert blocks["values"] == {"in_use": 5, "free": 3}
+        assert slots["t"] > 0
+
+    def test_old_format_steps_skipped(self):
+        from theanompi_tpu.utils.recorder import ServingRecorder
+
+        r = ServingRecorder(max_slots=4)
+        r.load_state_dict({
+            "max_slots": 4,
+            "requests": [],
+            "steps": [{"active_slots": 1, "queue_depth": 0,
+                       "dt_s": 0.01, "tokens": 1,
+                       "blocks_in_use": None, "blocks_free": None,
+                       "drafted": None, "accepted": None}],
+        })
+        assert r.counter_tracks() == []
+
+    def test_stamp_survives_state_roundtrip(self):
+        from theanompi_tpu.utils.recorder import ServingRecorder
+
+        a = ServingRecorder(max_slots=4)
+        a.record_step(active_slots=1, queue_depth=0, dt_s=0.01,
+                      tokens=1)
+        b = ServingRecorder(max_slots=4)
+        b.load_state_dict(json.loads(json.dumps(a.state_dict())))
+        assert len(b.counter_tracks()) == 1
+
+
+class TestTrainMetricsTxt:
+    def test_recorder_tm_train_families(self):
+        from theanompi_tpu.utils.recorder import Recorder
+
+        r = Recorder(verbose=False)
+        r.start()
+        r.end("calc")
+        r.train_error(0, 1.25, 0.5)
+        r.record_restart("crash", resumed_epoch=1, recovery_s=2.0,
+                         world_size=8, resharded=True)
+        txt = r.metrics_txt()
+        assert "tm_train_iterations_total 1" in txt
+        assert 'tm_train_seconds_total{mode="calc"}' in txt
+        assert "tm_train_restarts_total 1" in txt
+        assert "tm_train_resharded_total 1" in txt
+        assert "tm_train_mttr_seconds 2.0" in txt
+        assert "tm_train_world_size 8" in txt
+        assert "tm_train_loss 1.25" in txt
+        assert "tm_train_steps_per_sec" in txt
+
+    def test_world_size_override(self):
+        from theanompi_tpu.utils.recorder import Recorder
+
+        r = Recorder(verbose=False)
+        assert "tm_train_world_size 4" in r.metrics_txt(world_size=4)
+
+    def test_total_segments_persist(self):
+        from theanompi_tpu.utils.recorder import Recorder
+
+        a = Recorder(verbose=False)
+        a.start()
+        a.end("wait")
+        d = json.loads(json.dumps(a.state_dict()))
+        b = Recorder(verbose=False)
+        b.load_state_dict(d)
+        assert b.total_segments["wait"] == a.total_segments["wait"]
+
+    def test_old_checkpoint_seeds_calc_from_epoch_times(self):
+        """A pre-ISSUE-15 checkpoint lacks total_segments; the calc
+        denominator seeds from the epoch walls so a resumed
+        cumulative n_iter cannot inflate tm_train_steps_per_sec by
+        orders of magnitude (review finding)."""
+        from theanompi_tpu.utils.recorder import Recorder
+
+        r = Recorder(verbose=False)
+        r.load_state_dict({
+            "train_losses": [1.0] * 1000, "train_errors": [0.5] * 1000,
+            "val_records": [], "epoch_times": [50.0, 50.0],
+            "n_iter": 1000,
+        })
+        assert r.total_segments["calc"] == 100.0
+        assert "tm_train_steps_per_sec 10.0" in r.metrics_txt()
+
+    def test_profile_ids_unique_across_back_to_back_builds(self):
+        """Wall-clock-derived ids collided when two profiles were
+        built in the same microsecond (review finding)."""
+        a = _mk_profile().spans(t0=1000.0)
+        b = _mk_profile().spans(t0=1000.0)
+        ids = [s["span_id"] for s in a + b]
+        assert len(ids) == len(set(ids))
+        assert a[0]["trace_id"] != b[0]["trace_id"]
+
+    def test_leg_costs_not_mutated(self):
+        """step_profile's cost normalization deep-copies the caller's
+        dict and injects compute defaults into the COPY — reusing one
+        dict across two profiles must not leak model A's flops into
+        model B's compute leg (review finding)."""
+        from theanompi_tpu.obs.profiler import _normalize_leg_costs
+
+        costs = {"optimizer": {"flops": 10.0}}
+        a = _normalize_leg_costs(costs, 1e9, 1e6)
+        assert a["compute"] == {"flops": 1e9, "bytes": 1e6}
+        assert "compute" not in costs          # caller dict untouched
+        b = _normalize_leg_costs(costs, 2e9, None)
+        assert b["compute"]["flops"] == 2e9    # no cross-call leak
+        # caller-provided compute pricing wins over the injection
+        c = _normalize_leg_costs({"compute": {"flops": 7.0}}, 1e9, None)
+        assert c["compute"]["flops"] == 7.0
+
+    def test_supervisor_tm_train_families(self, tmp_path):
+        from theanompi_tpu.utils.supervisor import (
+            RestartEvent,
+            Supervisor,
+        )
+
+        sup = Supervisor(
+            cmd_for=lambda resume: ["true"],
+            checkpoint_dir=str(tmp_path),
+            elastic=True, n_devices=8,
+        )
+        sup.events.append(RestartEvent(
+            restart=1, cause="hang", exit_code=None, at_progress=3,
+            backoff_s=1.0, t_detect=0.0, recovery_s=4.0,
+            world_size=4, resharded=True,
+        ))
+        sup.world_history.append(4)
+        txt = sup.metrics_txt()
+        assert "tm_train_restarts_total 1" in txt
+        assert 'tm_train_restart_causes_total{cause="hang"} 1' in txt
+        assert "tm_train_mttr_seconds 4.0" in txt
+        assert "tm_train_resharded_total 1" in txt
+        assert "tm_train_world_size 4" in txt
+
+    def test_autoscaler_counter_tracks(self):
+        """Pressure samples ride the same counter schema — jax-free
+        via a stub router."""
+        from theanompi_tpu.serving.autoscaler import Autoscaler
+
+        class StubRouter:
+            recorder = type("R", (), {
+                "record_spawn": staticmethod(lambda *a, **k: None),
+            })()
+            tracer = None
+
+            def members(self):
+                return {}
+
+            def pending(self):
+                return 2
+
+            def fleet_capacity(self, default_slots):
+                return 4
+
+        asc = Autoscaler(
+            StubRouter(), spawn=lambda i: None, manage=[],
+            min_replicas=1, max_replicas=1,
+        )
+        asc.tick()     # pressure 0.5 sits between the thresholds
+        tracks = asc.counter_tracks()
+        assert len(tracks) == 1
+        assert tracks[0]["values"] == {"pressure": 0.5}
+        assert tracks[0]["name"] == "pressure"
+
+
+@pytest.mark.slow
+class TestRealCapture:
+    """Slow tier: a real device trace through the tiny Llama proxy,
+    and the BSP worker's ``step_profile`` knob end-to-end."""
+
+    def _build(self):
+        import jax
+
+        from theanompi_tpu.models.llama import Llama
+        from theanompi_tpu.parallel import make_mesh
+
+        devs = jax.devices("cpu")[:4]
+        K, B, T = 4, 2, 64
+        cfg = dict(dim=64, n_layers=1, n_heads=4, n_kv_heads=2,
+                   ffn_dim=128, vocab=256, seq_len=T, batch_size=B,
+                   lr=1e-3, seed=3, compute_dtype="float32",
+                   device_data_cache=True, steps_per_call=K,
+                   n_train=K * B * 4, n_val=4,
+                   exch_strategy="asa32", exchange_bucket_mb=0.01)
+        m = Llama(cfg)
+        m.build_model(n_replicas=4)
+        m.compile_iter_fns(mesh=make_mesh(data=4, devices=devs))
+        return m, K
+
+    def test_step_profile_real_trace(self):
+        from theanompi_tpu.obs import step_profile
+        from theanompi_tpu.utils import Recorder
+
+        m, K = self._build()
+        rec = Recorder(verbose=False)
+
+        def window():
+            m.train_chunk(0, K, rec)
+            rec.flush()
+
+        window()
+        window()
+        hlo = m.train_step_hlo_text()
+        prof = step_profile(
+            window, hlo_text=hlo, n_steps=K, n_devices=4,
+            name="llama_tiny", peak_flops=197e12, step_flops=1e9,
+        )
+        legs = prof.legs
+        assert "compute" in legs and "host_gap" in legs
+        assert sum(1 for k in legs if k.startswith("exchange_b")) >= 2
+        assert "optimizer" in legs
+        assert 0.9 <= prof.coverage <= 1.1
+        assert prof.gap is not None
+        json.dumps(prof.as_dict())
+        # the one-view export parses with the profile's own tracks
+        json.dumps(chrome_trace(prof.spans(),
+                                counters=prof.counter_tracks()))
+
+    def test_bsp_worker_step_profile_knob(self, tmp_path):
+        from theanompi_tpu.workers import bsp_worker
+
+        res = bsp_worker.run(
+            devices=list(range(4)),
+            modelfile="theanompi_tpu.models.wresnet",
+            modelclass="WResNet",
+            config={"batch_size": 2, "n_epochs": 1, "depth": 10,
+                    "widen": 1, "n_train": 16, "n_val": 8,
+                    "lr": 0.01, "step_profile": True,
+                    "trace": True,
+                    "trace_export": str(tmp_path / "tr.json")},
+            verbose=False,
+        )
+        prof = res["step_profile"]
+        assert prof and "error" not in prof, prof
+        assert "compute" in prof["legs"]
+        assert abs(prof["coverage"] - 1.0) <= 0.1, prof["coverage"]
+        # the export merged the profile spans + counter tracks into
+        # the iteration-span timeline (ONE Perfetto view)
+        doc = json.loads((tmp_path / "tr.json").read_text())
+        names = {e.get("name") for e in doc["traceEvents"]}
+        assert any(str(n).startswith("step_profile:") for n in names)
+        assert any(str(n).startswith("step_phase_s:") for n in names)
+        assert "iteration" in names

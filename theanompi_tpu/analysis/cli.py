@@ -21,7 +21,7 @@ import subprocess
 import sys
 from pathlib import Path
 
-from theanompi_tpu.analysis import core, hotpath, locks, refusals
+from theanompi_tpu.analysis import core, hotpath, locks, refusals, scopes
 
 DEFAULT_TARGETS = ("theanompi_tpu", "tests")
 
@@ -80,7 +80,8 @@ def run_suite(root: Path, targets,
     files = core.iter_source_files(root, targets)
     return core.collect(
         files,
-        rule_fns=(locks.check_file, hotpath.check_file),
+        rule_fns=(locks.check_file, hotpath.check_file,
+                  scopes.check_file),
         cross_fns=(locks.check_lock_order,),
         partial=partial,
     )

@@ -46,6 +46,8 @@ RULES = {
     "TM104": "host-sync fence in a JAX hot path",
     "TM105": "host-value-dependent shape in a JAX hot path",
     "TM106": "trace-time wall-clock/RNG call in a traced body",
+    "TM107": "jax.named_scope label not registered for profiler "
+             "attribution",
     "TM201": "stale tmcheck suppression (matches no finding)",
 }
 

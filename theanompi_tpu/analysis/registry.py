@@ -23,6 +23,13 @@ than invent one:
   wall-clock and host-RNG calls burn into the compiled artifact.
 - :data:`DENY_UNDER_LOCK` — the TM103 deny list, documented in
   docs/ANALYSIS.md.
+- :data:`PROFILE_SCOPES` / :data:`PROFILE_SCOPE_PREFIXES` — the
+  ``jax.named_scope`` labels the step-phase profiler
+  (``obs/profiler.py``) attributes trace time to, each mapped to its
+  leg name.  Rule TM107 (``scopes.py``): every ``jax.named_scope``
+  label in the tree must resolve here — an unregistered scope's ops
+  silently fall into the profiler's "compute (unscoped)" leg, so the
+  label would LOOK instrumented while measuring nothing.
 """
 
 from __future__ import annotations
@@ -89,6 +96,32 @@ DENY_UNDER_LOCK = {
                     "`collect_spans(...)` serializes/pulls a whole "
                     "span ring (possibly over the wire) while "
                     "holding a lock",
+}
+
+#: profiler-scope registry (rule TM107; consumed by
+#: ``obs/profiler.py``).  Exact ``jax.named_scope`` label -> the
+#: StepProfile leg its ops are attributed to.  A label absent from
+#: BOTH tables is TM107: the scope exists in the code but the
+#: profiler would silently file its ops under "compute (unscoped)".
+PROFILE_SCOPES: dict[str, str] = {
+    # compressed-exchange codec halves (parallel/exchange.py, PR 4)
+    "quantize_wire": "quantize",
+    "dequantize_wire": "quantize",
+    # optimizer update (models/base.py, models/llama.py,
+    # scatter_update_gather's per-bucket/monolithic update)
+    "opt_update": "optimizer",
+    # serving decode attribution (serving/decoder.py, PR 6)
+    "serving_sample": "sample",
+    "paged_attend": "attend",
+    "kv_write": "kv_write",
+}
+
+#: label PREFIX -> leg family: labels carrying a per-instance index
+#: (``exchange_b{i}`` — one leg per exchange bucket).  The profiler
+#: keeps the full label as the leg name; TM107 accepts any literal
+#: label (or f-string literal head) starting with a prefix.
+PROFILE_SCOPE_PREFIXES: dict[str, str] = {
+    "exchange_b": "exchange",
 }
 
 #: receiver-name hints -> class-name keywords, for resolving

@@ -1,0 +1,110 @@
+"""tmcheck rule family 5: profiler-scope registration (TM107).
+
+The step-phase profiler (``obs/profiler.py``) attributes device-trace
+time to ``jax.named_scope`` labels by extracting the labelled
+instruction names from the optimized HLO — but ONLY for labels it
+knows about (``registry.PROFILE_SCOPES`` exact labels and
+``registry.PROFILE_SCOPE_PREFIXES`` indexed families).  A
+``jax.named_scope`` call whose label is not registered is the silent
+failure mode ISSUE 15 names: the code LOOKS instrumented, yet every
+op under the scope lands in the profiler's "compute (unscoped)" leg
+and the new label measures nothing.
+
+TM107 therefore fires on any ``jax.named_scope(...)`` /
+``named_scope(...)`` call site whose label does not resolve:
+
+- a literal label must be a key of ``PROFILE_SCOPES`` or start with a
+  ``PROFILE_SCOPE_PREFIXES`` prefix;
+- an f-string label resolves through its leading LITERAL fragment
+  (the ``f"exchange_b{i}"`` family: the head must match a registered
+  prefix — a fully dynamic head can never be attributed);
+- a non-literal label (a variable, a call) cannot be checked against
+  the registry and is flagged too — thread the literal through, or
+  register the family prefix and build the label as an f-string.
+
+``test_*`` functions are NOT exempt here (unlike the hot-path seeds):
+a scope minted inside a test exercises the same attribution path.
+Fixture-only labels in tests ride the normal suppression syntax.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from theanompi_tpu.analysis.core import Finding, SourceFile
+from theanompi_tpu.analysis.registry import (
+    PROFILE_SCOPE_PREFIXES,
+    PROFILE_SCOPES,
+)
+
+RULE = "TM107"
+
+
+def label_registered(label: str) -> bool:
+    """Whether a LITERAL scope label resolves in the registry."""
+    if label in PROFILE_SCOPES:
+        return True
+    return any(label.startswith(p) for p in PROFILE_SCOPE_PREFIXES)
+
+
+def _is_named_scope_call(node: ast.Call) -> bool:
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr == "named_scope"
+    if isinstance(f, ast.Name):
+        return f.id == "named_scope"
+    return False
+
+
+def _literal_head(arg: ast.AST) -> tuple[str | None, bool]:
+    """``(label_or_head, is_full_literal)`` of the first argument.
+
+    A plain string constant returns ``(label, True)``; an f-string
+    returns its leading literal fragment and ``False`` (only a prefix
+    is checkable); anything else returns ``(None, False)``."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value, True
+    if isinstance(arg, ast.JoinedStr) and arg.values:
+        head = arg.values[0]
+        if isinstance(head, ast.Constant) and isinstance(head.value, str):
+            return head.value, False
+    return None, False
+
+
+def check_file(sf: SourceFile) -> list[Finding]:
+    out: list[Finding] = []
+    for node in ast.walk(sf.tree):
+        if not (isinstance(node, ast.Call) and _is_named_scope_call(node)):
+            continue
+        if not node.args:
+            continue
+        label, full = _literal_head(node.args[0])
+        if label is None:
+            out.append(Finding(
+                sf.rel, node.lineno, RULE,
+                "jax.named_scope label is not a (f-)string literal — "
+                "the profiler cannot attribute a dynamic scope; use a "
+                "registered label or a registered-prefix f-string "
+                "(analysis/registry.py PROFILE_SCOPES)",
+            ))
+            continue
+        if full and label_registered(label):
+            continue
+        if not full and any(
+            label.startswith(p) for p in PROFILE_SCOPE_PREFIXES
+        ):
+            # f-string whose literal head carries a FULL registered
+            # prefix (f"exchange_b{i}").  A shorter head
+            # (f"exchange_{x}", f"e{i}") is flagged: the profiler's
+            # label regex matches the whole prefix + digits, so such
+            # labels would silently land in the unscoped-compute leg
+            # — the exact failure mode this rule exists for.
+            continue
+        out.append(Finding(
+            sf.rel, node.lineno, RULE,
+            f"jax.named_scope label {label!r} is not registered in "
+            f"analysis/registry.py (PROFILE_SCOPES/"
+            f"PROFILE_SCOPE_PREFIXES) — its ops silently fall into "
+            f"the profiler's unscoped-compute leg",
+        ))
+    return out

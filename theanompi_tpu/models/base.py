@@ -794,9 +794,12 @@ class ClassifierModel(TMModel):
                         ef = {"r1": r1n, "r2": r2n}
                 else:
                     grads = strat(grads, DATA_AXIS, bucket_elems)
-                params, opt_state = optimizer.update(
-                    params, grads, opt_state, lr
-                )
+                # profiler scope (obs/profiler.py): the optimizer
+                # update is its own step-phase leg
+                with jax.named_scope("opt_update"):
+                    params, opt_state = optimizer.update(
+                        params, grads, opt_state, lr
+                    )
             return params, new_state, opt_state, ef, loss, err
 
         def shard_val(params, net_state, x, y):
@@ -1059,6 +1062,40 @@ class ClassifierModel(TMModel):
                 jnp.float32(self.current_lr), self._rng,
             )
         return lowered.compile().cost_analysis()
+
+    def train_step_hlo_text(self):
+        """Optimized-HLO text of the ACTIVE training executable — the
+        K-step scan when compiled (what ``train_chunk`` actually
+        dispatches), else the cached/staged single step.  The
+        step-phase profiler's scope-attribution source
+        (``obs/profiler.py``): HLO instruction names are
+        module-unique, so the text must come from the executable the
+        profiled window runs.  Call after one warm ``train_chunk``."""
+        from theanompi_tpu.utils.trace_comm import compiled_hlo_text
+
+        if self._train_scan is not None and self._perm_dev is not None:
+            lowered = self._train_scan.lower(
+                self.params, self.net_state, self.opt_state,
+                self.ef_state, self._step_dev, self._device_cache[0],
+                self._device_cache[1], self._perm_dev, self._lr_dev,
+                self._key0_dev,
+            )
+        elif (self._train_step_cached is not None
+              and self._perm_dev is not None):
+            lowered = self._train_step_cached.lower(
+                self.params, self.net_state, self.opt_state,
+                self.ef_state, self._step_dev, self._device_cache[0],
+                self._device_cache[1], self._perm_dev, self._lr_dev,
+                self._key0_dev,
+            )
+        else:
+            x, y = self.put_batch(self.data.train_batch(0))
+            lowered = self._train_step.lower(
+                self.params, self.net_state, self.opt_state,
+                self.ef_state, x, y,
+                jnp.float32(self.current_lr), self._rng,
+            )
+        return compiled_hlo_text(lowered.compile())
 
     def train_chunk(self, count: int, k: int, recorder: Recorder) -> None:
         """Run steps ``count .. count+k-1``: ONE device dispatch when

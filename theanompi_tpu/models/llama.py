@@ -928,9 +928,10 @@ class Llama(TMModel):
                     return strat(g, dp_spec)
 
                 grads = jax.tree.map(exch, grads, expert_mask)
-                params, opt_state = optimizer.update(
-                    params, grads, opt_state, lr
-                )
+                with jax.named_scope("opt_update"):
+                    params, opt_state = optimizer.update(
+                        params, grads, opt_state, lr
+                    )
             elif zero1:
                 # ZeRO-1: reduce-scatter the packed local grads over
                 # the DP replica axes, update the optimizer on this
@@ -976,9 +977,12 @@ class Llama(TMModel):
                         ef = {"r1": r1n, "r2": r2n}
                 else:
                     grads = strat(grads, dp_spec, bucket_elems)
-                params, opt_state = optimizer.update(
-                    params, grads, opt_state, lr
-                )
+                # profiler scope (obs/profiler.py): the optimizer
+                # update is its own step-phase leg
+                with jax.named_scope("opt_update"):
+                    params, opt_state = optimizer.update(
+                        params, grads, opt_state, lr
+                    )
             loss = lax.pmean(loss, dp_axes)
             err = lax.pmean(err, dp_axes)
             return params, opt_state, ef, loss, err
@@ -1249,6 +1253,31 @@ class Llama(TMModel):
             self.params, self.opt_state, self.ef_state, x, y,
             jnp.float32(self.current_lr),
         ).compile().cost_analysis()
+
+    def train_step_hlo_text(self):
+        """Optimized-HLO text of the ACTIVE training executable — the
+        K-step scan when compiled (what ``train_chunk`` actually
+        dispatches), else the single step.  The step-phase profiler's
+        scope-attribution source (``obs/profiler.py``): HLO
+        instruction names are module-unique, so the text must come
+        from the executable the profiled window runs.  Call after one
+        warm ``train_chunk`` (the scan path stages lr/permutation
+        lazily)."""
+        from theanompi_tpu.utils.trace_comm import compiled_hlo_text
+
+        if self._train_scan is not None and self._perm_dev is not None:
+            lowered = self._train_scan.lower(
+                self.params, self.opt_state, self.ef_state,
+                self._step_dev, self._seqs_dev, self._perm_dev,
+                self._lr_dev,
+            )
+        else:
+            x, y = self.put_batch(self.data.train_batch(0))
+            lowered = self._train_step.lower(
+                self.params, self.opt_state, self.ef_state, x, y,
+                jnp.float32(self.current_lr),
+            )
+        return compiled_hlo_text(lowered.compile())
 
     def train_iter(self, count: int, recorder: Recorder) -> None:
         if self._train_scan is not None:

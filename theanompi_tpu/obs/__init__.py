@@ -1,6 +1,8 @@
 """Observability subsystem: distributed span tracing (bounded
-flight-recorder, Perfetto export, critical-path attribution) and
-Prometheus-style metrics text.  See docs/OBSERVABILITY.md."""
+flight-recorder, Perfetto export with counter tracks, critical-path
+attribution), the step-phase profiler (``profiler.py``), the bench
+regression gate (``regress.py``), and Prometheus-style metrics text.
+See docs/OBSERVABILITY.md and docs/PERFORMANCE.md."""
 
 from theanompi_tpu.obs.tracer import (  # noqa: F401
     DEFAULT_TRACE_SAMPLE,
@@ -20,18 +22,30 @@ from theanompi_tpu.obs.metrics import (  # noqa: F401
     quantile_samples,
     render_metrics,
 )
+from theanompi_tpu.obs.profiler import (  # noqa: F401
+    StepProfile,
+    format_profile,
+    gap_attribution,
+    profile_scope_sets,
+    step_profile,
+)
 
 __all__ = [
     "DEFAULT_TRACE_SAMPLE",
+    "StepProfile",
     "Tracer",
     "child_context",
     "chrome_trace",
     "critical_path",
     "force_sample",
     "format_critical_path",
+    "format_profile",
+    "gap_attribution",
     "make_context",
+    "profile_scope_sets",
     "quantile_samples",
     "render_metrics",
     "span_tree",
+    "step_profile",
     "write_chrome_trace",
 ]

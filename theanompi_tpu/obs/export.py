@@ -9,6 +9,16 @@ the Chrome trace-event JSON Perfetto opens directly
 supervisor, the BSP worker), one thread lane per ``span["lane"]``
 (a replica's role), complete ("X") events in microseconds.
 
+``counters=`` adds Chrome COUNTER tracks ("ph": "C") to the same
+view: each sample is ``{"process", "name", "t", "values": {series:
+number}}`` — the StepProfile's per-phase/MFU gauges
+(``StepProfile.counter_tracks``), the serving recorder's queue-depth
+/ blocks-in-use series (``ServingRecorder.counter_tracks``), and the
+autoscaler's pressure samples (``Autoscaler.counter_tracks``) all
+render as stacked counter lanes under their process, so a bench
+run's profile and its request traces open as ONE timeline (ISSUE 15
+tentpole c).
+
 ``critical_path`` answers "why was this request slow": the longest
 SERIAL chain through one trace's span tree.  Walking BACKWARD from
 the root's end, each step follows the child whose completion gated
@@ -32,9 +42,12 @@ def _span_sort_key(s: dict):
     return (s["t0"], s["t1"], s["span_id"])
 
 
-def chrome_trace(spans, *, trace_id: int | None = None) -> dict:
+def chrome_trace(spans, *, trace_id: int | None = None,
+                 counters=None) -> dict:
     """Chrome trace-event JSON (a dict; ``json.dumps`` it to a file
-    and open in Perfetto).  ``trace_id`` filters to one tree."""
+    and open in Perfetto).  ``trace_id`` filters the SPANS to one
+    tree; ``counters`` (see module doc) always export whole — a
+    gauge series has no trace id."""
     spans = [
         s for s in spans
         if trace_id is None or s["trace_id"] == trace_id
@@ -56,6 +69,16 @@ def chrome_trace(spans, *, trace_id: int | None = None) -> dict:
                 "parent_id": s["parent_id"], **(s.get("attrs") or {}),
             },
         })
+    for c in sorted(counters or (),
+                    key=lambda c: (c["process"], c["name"], c["t"])):
+        pid = procs.setdefault(c["process"], len(procs) + 1)
+        events.append({
+            "ph": "C", "name": c["name"], "pid": pid,
+            "ts": float(c["t"]) * 1e6,
+            "args": {
+                k: v for k, v in c["values"].items() if v is not None
+            },
+        })
     meta = []
     for name, pid in procs.items():
         meta.append({"ph": "M", "name": "process_name", "pid": pid,
@@ -67,11 +90,14 @@ def chrome_trace(spans, *, trace_id: int | None = None) -> dict:
     return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
 
 
-def write_chrome_trace(spans, path, *, trace_id: int | None = None
-                       ) -> str:
+def write_chrome_trace(spans, path, *, trace_id: int | None = None,
+                       counters=None) -> str:
     """Dump ``chrome_trace`` to ``path``; returns the path."""
     with open(path, "w") as f:
-        json.dump(chrome_trace(spans, trace_id=trace_id), f)
+        json.dump(
+            chrome_trace(spans, trace_id=trace_id, counters=counters),
+            f,
+        )
     return str(path)
 
 

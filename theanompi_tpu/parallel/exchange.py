@@ -86,28 +86,36 @@ def allreduce_mean(
         if spec.n_buckets > 1:
             parts = []
             for i in range(spec.n_buckets):
-                b = flat_pack_bucket(tree, spec, i)
-                w = b if wire_dtype is None else b.astype(wire_dtype)
-                if two_phase:
-                    part = lax.psum_scatter(
-                        w, axes, scatter_dimension=0, tiled=True
-                    )
-                    w = lax.all_gather(part, axes, axis=0, tiled=True)
-                else:
-                    w = lax.psum(w, axes)
-                parts.append((w / n).astype(spec.dtype))
+                # per-bucket profiler scope (obs/profiler.py leg
+                # attribution); label prefix registered as a
+                # PROFILE_SCOPE_PREFIX in analysis/registry.py
+                with jax.named_scope(f"exchange_b{i}"):
+                    b = flat_pack_bucket(tree, spec, i)
+                    w = b if wire_dtype is None else b.astype(wire_dtype)
+                    if two_phase:
+                        part = lax.psum_scatter(
+                            w, axes, scatter_dimension=0, tiled=True
+                        )
+                        w = lax.all_gather(part, axes, axis=0, tiled=True)
+                    else:
+                        w = lax.psum(w, axes)
+                    parts.append((w / n).astype(spec.dtype))
             return flat_unpack(jnp.concatenate(parts), spec)
 
     def one(x):
         orig = x.dtype
-        w = x if wire_dtype is None else x.astype(wire_dtype)
-        if two_phase and w.shape and w.shape[0] % n == 0:
-            # reduce_scatter over leading dim, then all_gather back.
-            part = lax.psum_scatter(w, axes, scatter_dimension=0, tiled=True)
-            w = lax.all_gather(part, axes, axis=0, tiled=True)
-        else:
-            w = lax.psum(w, axes)
-        return (w / n).astype(orig)
+        # the monolithic exchange is "bucket 0" to the profiler
+        with jax.named_scope("exchange_b0"):
+            w = x if wire_dtype is None else x.astype(wire_dtype)
+            if two_phase and w.shape and w.shape[0] % n == 0:
+                # reduce_scatter over leading dim, then all_gather back.
+                part = lax.psum_scatter(
+                    w, axes, scatter_dimension=0, tiled=True
+                )
+                w = lax.all_gather(part, axes, axis=0, tiled=True)
+            else:
+                w = lax.psum(w, axes)
+            return (w / n).astype(orig)
 
     return jax.tree.map(one, tree)
 
@@ -438,19 +446,25 @@ def compressed_allreduce_mean(
     bs = spec.bucket_shard_len
     parts, r1_parts, r2_parts = [], [], []
     for i in range(nb):
-        g = flat_pack_bucket(tree, spec, i).astype(jnp.float32)
-        if r1 is not None:
-            g = g + lax.slice_in_dim(r1, i * bl, (i + 1) * bl)
-        shard_sum, dec1 = _compressed_reduce_scatter(g, axes, n, compression)
-        if r1 is not None:
-            r1_parts.append(g - dec1)
-        m = shard_sum / n
-        if r2 is not None:
-            m = m + lax.slice_in_dim(r2, i * bs, (i + 1) * bs)
-        full, dec2 = _compressed_all_gather(m, axes, n, compression)
-        if r2 is not None:
-            r2_parts.append(m - dec2)
-        parts.append(full.astype(spec.dtype))
+        # per-bucket profiler scope (obs/profiler.py leg attribution);
+        # the nested quantize_wire/dequantize_wire scopes take
+        # priority in the profiler's first-match-wins assignment
+        with jax.named_scope(f"exchange_b{i}"):
+            g = flat_pack_bucket(tree, spec, i).astype(jnp.float32)
+            if r1 is not None:
+                g = g + lax.slice_in_dim(r1, i * bl, (i + 1) * bl)
+            shard_sum, dec1 = _compressed_reduce_scatter(
+                g, axes, n, compression
+            )
+            if r1 is not None:
+                r1_parts.append(g - dec1)
+            m = shard_sum / n
+            if r2 is not None:
+                m = m + lax.slice_in_dim(r2, i * bs, (i + 1) * bs)
+            full, dec2 = _compressed_all_gather(m, axes, n, compression)
+            if r2 is not None:
+                r2_parts.append(m - dec2)
+            parts.append(full.astype(spec.dtype))
     buf = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
     return (
         flat_unpack(buf, spec),
@@ -587,35 +601,43 @@ def scatter_update_gather(
 
     r1_new = None
     if spec.n_buckets == 1:
-        g_flat = flat_pack(grads, spec)
-        if compression is not None:
-            g32 = g_flat.astype(jnp.float32)
-            if r1 is not None:
-                g32 = g32 + r1
-            g_sum, dec = _compressed_reduce_scatter(
-                g32, axes, n, compression
-            )
-            if r1 is not None:
-                r1_new = g32 - dec
-            g_shard = (g_sum / n).astype(spec.dtype)
-        else:
-            w = g_flat if wire_dtype is None else g_flat.astype(wire_dtype)
-            g_shard = lax.psum_scatter(
-                w, axes, scatter_dimension=0, tiled=True
-            )
-            g_shard = g_shard.astype(spec.dtype) / n
+        # profiler scopes (obs/profiler.py): the collective legs are
+        # "bucket 0" of the exchange; the optimizer update is its own
+        # leg — both labels registered in analysis/registry.py
+        with jax.named_scope("exchange_b0"):
+            g_flat = flat_pack(grads, spec)
+            if compression is not None:
+                g32 = g_flat.astype(jnp.float32)
+                if r1 is not None:
+                    g32 = g32 + r1
+                g_sum, dec = _compressed_reduce_scatter(
+                    g32, axes, n, compression
+                )
+                if r1 is not None:
+                    r1_new = g32 - dec
+                g_shard = (g_sum / n).astype(spec.dtype)
+            else:
+                w = (g_flat if wire_dtype is None
+                     else g_flat.astype(wire_dtype))
+                g_shard = lax.psum_scatter(
+                    w, axes, scatter_dimension=0, tiled=True
+                )
+                g_shard = g_shard.astype(spec.dtype) / n
 
-        p_flat = _pvary(flat_pack(params, spec), axes)
-        p_shard = lax.dynamic_slice_in_dim(
-            p_flat, _flat_axis_index(axes) * spec.shard_len, spec.shard_len
-        )
-        if opt_state is None:
-            new_p_shard, aux = opt_update(p_shard, g_shard)
-        else:
-            new_p_shard, aux = opt_update(p_shard, g_shard, opt_state)
-        p_new = gather(
-            new_p_shard.astype(spec.dtype), axes, axis=0, tiled=True
-        )
+            p_flat = _pvary(flat_pack(params, spec), axes)
+            p_shard = lax.dynamic_slice_in_dim(
+                p_flat, _flat_axis_index(axes) * spec.shard_len,
+                spec.shard_len,
+            )
+        with jax.named_scope("opt_update"):
+            if opt_state is None:
+                new_p_shard, aux = opt_update(p_shard, g_shard)
+            else:
+                new_p_shard, aux = opt_update(p_shard, g_shard, opt_state)
+        with jax.named_scope("exchange_b0"):
+            p_new = gather(
+                new_p_shard.astype(spec.dtype), axes, axis=0, tiled=True
+            )
         if compression is not None:
             return flat_unpack(p_new, spec), aux, r1_new
         return flat_unpack(p_new, spec), aux
@@ -634,21 +656,27 @@ def scatter_update_gather(
     g_shards, r1_parts = [], []
     bl = spec.bucket_len
     for i in range(nb):
-        gb = flat_pack_bucket(grads, spec, i)
-        if compression is not None:
-            g32 = gb.astype(jnp.float32)
-            if r1 is not None:
-                g32 = g32 + lax.slice_in_dim(r1, i * bl, (i + 1) * bl)
-            g_sum, dec = _compressed_reduce_scatter(
-                g32, axes, n, compression
-            )
-            if r1 is not None:
-                r1_parts.append(g32 - dec)
-            g_shards.append((g_sum / n).astype(spec.dtype))
-        else:
-            w = gb if wire_dtype is None else gb.astype(wire_dtype)
-            gs = lax.psum_scatter(w, axes, scatter_dimension=0, tiled=True)
-            g_shards.append(gs.astype(spec.dtype) / n)
+        # per-bucket profiler scope (obs/profiler.py leg attribution)
+        with jax.named_scope(f"exchange_b{i}"):
+            gb = flat_pack_bucket(grads, spec, i)
+            if compression is not None:
+                g32 = gb.astype(jnp.float32)
+                if r1 is not None:
+                    g32 = g32 + lax.slice_in_dim(
+                        r1, i * bl, (i + 1) * bl
+                    )
+                g_sum, dec = _compressed_reduce_scatter(
+                    g32, axes, n, compression
+                )
+                if r1 is not None:
+                    r1_parts.append(g32 - dec)
+                g_shards.append((g_sum / n).astype(spec.dtype))
+            else:
+                w = gb if wire_dtype is None else gb.astype(wire_dtype)
+                gs = lax.psum_scatter(
+                    w, axes, scatter_dimension=0, tiled=True
+                )
+                g_shards.append(gs.astype(spec.dtype) / n)
     if r1_parts:
         r1_new = jnp.concatenate(r1_parts)
 
@@ -665,9 +693,10 @@ def scatter_update_gather(
     if opt_state is None:
         # legacy closure: one full-shard update between the pipelined
         # collective phases
-        new_p, aux = opt_update(
-            jnp.concatenate(p_buckets), jnp.concatenate(g_shards)
-        )
+        with jax.named_scope("opt_update"):
+            new_p, aux = opt_update(
+                jnp.concatenate(p_buckets), jnp.concatenate(g_shards)
+            )
         new_p_buckets = [
             lax.slice_in_dim(new_p, i * bs, (i + 1) * bs)
             for i in range(nb)
@@ -675,10 +704,11 @@ def scatter_update_gather(
     else:
         new_p_buckets, aux_parts = [], []
         for i in range(nb):
-            np_i, aux_i = opt_update(
-                p_buckets[i], g_shards[i],
-                _slice_shard_state(opt_state, spec, i),
-            )
+            with jax.named_scope("opt_update"):
+                np_i, aux_i = opt_update(
+                    p_buckets[i], g_shards[i],
+                    _slice_shard_state(opt_state, spec, i),
+                )
             new_p_buckets.append(np_i)
             aux_parts.append(aux_i)
         aux = _concat_shard_state(opt_state, aux_parts, spec)
@@ -686,10 +716,12 @@ def scatter_update_gather(
     # phase 3: per-bucket all-gather of the updated params — bucket
     # i's gather dispatches as soon as ITS update lands, under bucket
     # i+1's update compute
-    parts = [
-        gather(np_i.astype(spec.dtype), axes, axis=0, tiled=True)
-        for np_i in new_p_buckets
-    ]
+    parts = []
+    for i, np_i in enumerate(new_p_buckets):
+        with jax.named_scope(f"exchange_b{i}"):
+            parts.append(
+                gather(np_i.astype(spec.dtype), axes, axis=0, tiled=True)
+            )
     if compression is not None:
         return flat_unpack(jnp.concatenate(parts), spec), aux, r1_new
     return flat_unpack(jnp.concatenate(parts), spec), aux

@@ -56,6 +56,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 
 from theanompi_tpu.utils.faults import LoadSpike, maybe_inject_fault
 
@@ -155,6 +156,10 @@ class Autoscaler:
         self.events: list[dict] = []
         self.n_ticks = 0
         self.last_pressure: float | None = None
+        # bounded pressure history (wall-stamped) — the counter track
+        # the single-view Perfetto export renders next to the request
+        # spans (obs/export.chrome_trace counters=; ISSUE 15)
+        self.pressure_samples: deque = deque(maxlen=4096)
         self._spawn_idx = len(self.managed)
         self._above_since: float | None = None
         self._below_since: float | None = None
@@ -288,6 +293,7 @@ class Autoscaler:
         now = time.monotonic()
         p = self.pressure()
         self.last_pressure = p
+        self.pressure_samples.append((time.time(), p))
         if spike:
             # drill semantics: the spike IS the sustained-backpressure
             # certificate — act now, hysteresis and cooldown bypassed
@@ -361,6 +367,17 @@ class Autoscaler:
             ),
             "events": list(self.events),
         }
+
+    def counter_tracks(self, process: str = "autoscaler") -> list:
+        """Chrome-trace counter samples of the pressure signal
+        (``obs/export.chrome_trace``'s ``counters=``) — the gauge
+        lane that explains WHY a scale_up span sits where it does in
+        the single-view export."""
+        return [
+            {"process": process, "name": "pressure", "t": t,
+             "values": {"pressure": round(p, 4)}}
+            for t, p in list(self.pressure_samples)
+        ]
 
     def metrics_txt(self, prefix: str = "tm_autoscaler") -> str:
         """Prometheus-style text for the control plane (stable

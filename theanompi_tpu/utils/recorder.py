@@ -55,6 +55,9 @@ class Recorder:
         self._t0: Optional[float] = None
         self.segments = {m: 0.0 for m in MODES}   # current-iteration
         self.epoch_segments = {m: 0.0 for m in MODES}
+        # run-cumulative segment totals (never reset): the step-rate
+        # denominator metrics_txt exports as tm_train_*
+        self.total_segments = {m: 0.0 for m in MODES}
 
         self._train_losses: list[float] = []
         self._train_errors: list[float] = []
@@ -124,6 +127,7 @@ class Recorder:
         dt = time.perf_counter() - self._t0
         self.segments[mode] += dt
         self.epoch_segments[mode] += dt
+        self.total_segments[mode] += dt
         self._t0 = None
         if (
             self._tracer is not None and self._iter_root is not None
@@ -204,6 +208,7 @@ class Recorder:
         dt = time.perf_counter() - t0
         self.segments["calc"] += dt
         self.epoch_segments["calc"] += dt
+        self.total_segments["calc"] += dt
         if not self._window:
             return
         losses, errs = zip(*self._window)
@@ -284,6 +289,7 @@ class Recorder:
         dt = time.perf_counter() - t0
         self.segments["calc"] += dt
         self.epoch_segments["calc"] += dt
+        self.total_segments["calc"] += dt
         wall = time.perf_counter() - self._epoch_start
         self.epoch_times.append(wall)
         if self.verbose:
@@ -301,6 +307,48 @@ class Recorder:
                 f" wait {seg['wait']:.1f}s){val_str}",
                 flush=True,
             )
+
+    def metrics_txt(self, prefix: str = "tm_train",
+                    world_size: int | None = None) -> str:
+        """Prometheus-style text for the TRAINING loop (ISSUE 15
+        satellite: PR 12 exported serving/fleet/autoscaler metrics
+        but left training unexported): step rate over cumulative calc
+        time, per-mode wall totals, restart/MTTR/reshard accounting
+        from the restart events, latest loss.  ``world_size`` — the
+        current DP width (the worker passes it; falls back to the
+        newest restart event's stamp)."""
+        from theanompi_tpu.obs.metrics import render_metrics
+
+        self.flush()
+        calc = self.total_segments["calc"]
+        if world_size is None:
+            stamps = [
+                e.get("world_size") for e in self.restart_events
+                if e.get("world_size") is not None
+            ]
+            world_size = stamps[-1] if stamps else None
+        resharded = sum(
+            1 for e in self.restart_events if e.get("resharded")
+        )
+        p = prefix
+        return render_metrics([
+            (f"{p}_iterations_total", "counter", [(None, self.n_iter)]),
+            (f"{p}_epochs_total", "counter",
+             [(None, len(self.epoch_times))]),
+            (f"{p}_seconds_total", "counter", [
+                ({"mode": m}, self.total_segments[m]) for m in MODES
+            ]),
+            (f"{p}_steps_per_sec", "gauge",
+             [(None, self.n_iter / calc if calc else None)]),
+            (f"{p}_loss", "gauge",
+             [(None, self._train_losses[-1]
+               if self._train_losses else None)]),
+            (f"{p}_restarts_total", "counter",
+             [(None, len(self.restart_events))]),
+            (f"{p}_resharded_total", "counter", [(None, resharded)]),
+            (f"{p}_mttr_seconds", "gauge", [(None, self.mttr_s)]),
+            (f"{p}_world_size", "gauge", [(None, world_size)]),
+        ])
 
     # -- profiler handoff (SURVEY §5.1 rebuild note) ----------------------
 
@@ -325,6 +373,7 @@ class Recorder:
             "epoch_times": self.epoch_times,
             "n_iter": self.n_iter,
             "restart_events": self.restart_events,
+            "total_segments": dict(self.total_segments),
         }
 
     def save(self, path: str | Path) -> None:
@@ -339,6 +388,18 @@ class Recorder:
         self.n_iter = int(d["n_iter"])
         # absent in pre-resilience checkpoints
         self.restart_events = list(d.get("restart_events", []))
+        # run-cumulative totals resume where the checkpointed life
+        # left them.  Pre-ISSUE-15 checkpoints lack the key: seed
+        # calc from the epoch walls (epoch time is calc-dominated on
+        # every contract path) rather than 0.0 — a zero denominator
+        # under a resumed cumulative n_iter would inflate
+        # tm_train_steps_per_sec by orders of magnitude
+        tot = d.get("total_segments")
+        if tot is None:
+            tot = {"calc": float(sum(self.epoch_times))}
+        self.total_segments = {
+            m: float(tot.get(m, 0.0)) for m in MODES
+        }
         self._last_print = self.n_iter
 
     def load(self, path: str | Path) -> None:
@@ -546,6 +607,11 @@ class ServingRecorder:
         accepted: int | None = None,
     ) -> None:
         s = {
+            # wall stamp: what anchors this step's gauges on the
+            # Perfetto counter tracks (counter_tracks below) — the
+            # tracer's span stamps are wall-shifted monotonic, so
+            # time.time() lands the gauges on the same timeline
+            "t": time.time(),
             "active_slots": int(active_slots),
             "queue_depth": int(queue_depth),
             "dt_s": float(dt_s),
@@ -765,6 +831,36 @@ class ServingRecorder:
             "blocks_in_use_max": self.blocks_in_use_max,
             "blocks_free_min": self.blocks_free_min,
         }
+
+    def counter_tracks(self, process: str = "serving") -> list:
+        """Chrome-trace counter samples from the rolling step window
+        (``obs/export.chrome_trace``'s ``counters=``): queue depth +
+        active slots on one track, KV block gauges on another — the
+        lanes that open in the SAME Perfetto view as the request
+        spans and a StepProfile's phase tracks (ISSUE 15 tentpole c).
+        Steps recorded by a pre-stamp peer (no ``t``) are skipped."""
+        out = []
+        for s in list(self.steps):
+            t = s.get("t")
+            if t is None:
+                continue
+            out.append({
+                "process": process, "name": "slots", "t": t,
+                "values": {
+                    "active_slots": s["active_slots"],
+                    "queue_depth": s["queue_depth"],
+                },
+            })
+            if s.get("blocks_in_use") is not None \
+                    or s.get("blocks_free") is not None:
+                out.append({
+                    "process": process, "name": "kv_blocks", "t": t,
+                    "values": {
+                        "in_use": s.get("blocks_in_use"),
+                        "free": s.get("blocks_free"),
+                    },
+                })
+        return out
 
     def metrics_txt(self, prefix: str = "tm_serving") -> str:
         """Prometheus-style text exposition of the summary (stable

@@ -64,6 +64,52 @@ V5E = ChipSpec(
     dcn_bw_per_chip=3.125e9,   # 200 Gbps NIC per 8-chip host
 )
 
+#: peak dense bf16 FLOP/s per chip by PJRT device_kind prefix — THE
+#: MFU denominator (bench.py and the step-phase profiler share it)
+PEAK_BF16 = {
+    "TPU v2": 45e12,
+    "TPU v3": 123e12,
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def peak_flops_per_chip(devices) -> float | None:
+    """Datasheet peak for the first device's kind (None off-TPU —
+    CPU-mesh MFU figures would be meaningless as absolutes; callers
+    that still want a consistent RELATIVE figure pass ``V5E.peak_bf16``
+    explicitly, as the CPU-mesh bench rows do)."""
+    kind = getattr(devices[0], "device_kind", "") if devices else ""
+    for name, peak in PEAK_BF16.items():
+        if kind.startswith(name):
+            return peak
+    return None
+
+
+def cost_analysis_totals(ca, n_devices: int) -> tuple[float, float]:
+    """``(total_flops, total_bytes_accessed)`` across ALL devices
+    from an XLA ``cost_analysis()`` result — THE one normalizer
+    (bench.py, the step-phase profiler, and the BSP worker's
+    ``step_profile`` knob all read it).  The dict API reports the
+    PER-DEVICE partitioned module (verified on this image: a
+    4-way-sharded 4.19M-FLOP matmul reports 1.05M), so it scales by
+    ``n_devices``; the old list API is one dict per partition and
+    sums to the total."""
+    if isinstance(ca, list):
+        return (
+            sum(float(d.get("flops", 0.0)) for d in ca),
+            sum(float(d.get("bytes accessed", 0.0)) for d in ca),
+        )
+    return (
+        float(ca.get("flops", 0.0)) * n_devices,
+        float(ca.get("bytes accessed", 0.0)) * n_devices,
+    )
+
 
 def ici_links_used(n_chips: int) -> int:
     """Links a BSP allreduce can drive on an n-chip v5e slice: one

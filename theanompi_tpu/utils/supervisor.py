@@ -688,6 +688,41 @@ class Supervisor:
             time.sleep(delay)
             resume = True
 
+    def metrics_txt(self, prefix: str = "tm_train") -> str:
+        """Prometheus-style text for the supervision loop (ISSUE 15
+        satellite — the training-side counterpart of the PR 12
+        serving exports): restart counts by cause, MTTR, the elastic
+        world size and reshard count.  Callable mid-run (the events
+        list grows live) or after ``run()`` returns."""
+        from collections import Counter
+
+        from theanompi_tpu.obs.metrics import render_metrics
+
+        recoveries = [
+            e.recovery_s for e in self.events
+            if e.recovery_s is not None
+        ]
+        causes = Counter(e.cause for e in self.events)
+        resharded = sum(1 for e in self.events if e.resharded)
+        world = (
+            self.world_history[-1]
+            if self.elastic and self.world_history else None
+        )
+        p = prefix
+        return render_metrics([
+            (f"{p}_restarts_total", "counter",
+             [(None, len(self.events))]),
+            (f"{p}_restart_causes_total", "counter", [
+                ({"cause": c}, n) for c, n in sorted(causes.items())
+            ]),
+            (f"{p}_mttr_seconds", "gauge",
+             [(None, sum(recoveries) / len(recoveries)
+               if recoveries else None)]),
+            (f"{p}_resharded_total", "counter", [(None, resharded)]),
+            (f"{p}_world_size", "gauge", [(None, world)]),
+            (f"{p}_supervised", "gauge", [(None, True)]),
+        ])
+
     def _report(self, completed: bool, final_hb: dict | None) -> dict:
         if self.tracer is not None and \
                 getattr(self, "_run_root", None) is not None:

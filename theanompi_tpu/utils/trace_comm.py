@@ -104,17 +104,20 @@ def capture_trace(fn: Callable[[], Any], trace_dir: str) -> Any:
 
 
 def report_of(fn: Callable[[], Any], top_n: int = 15,
-              quant_ops: set | None = None) -> dict:
+              quant_ops: set | None = None,
+              scopes: dict | None = None) -> dict:
     """Capture ``fn`` into a temp dir and return its ``comm_report``
     — the one-shot capture-and-attribute recipe shared by bench.py
     and the multichip gate (``fn`` must fence its own device work,
     e.g. by a value read).  ``quant_ops`` — instruction names from
-    ``scope_op_names`` to attribute as quantize/dequantize compute."""
+    ``scope_op_names`` to attribute as quantize/dequantize compute;
+    ``scopes`` — the profiler's ordered per-leg op-name sets."""
     import tempfile
 
     with tempfile.TemporaryDirectory() as td:
         capture_trace(fn, td)
-        return comm_report(td, top_n=top_n, quant_ops=quant_ops)
+        return comm_report(td, top_n=top_n, quant_ops=quant_ops,
+                           scopes=scopes)
 
 
 # -- quantize/dequantize attribution (exch_compression) ---------------------
@@ -135,6 +138,20 @@ QUANT_SCOPE_MARKERS = ("quantize_wire", "dequantize_wire")
 _HLO_INSTR_RE = None
 
 
+def hlo_instr_re():
+    """The compiled instruction-metadata regex (public accessor —
+    the step-phase profiler's per-scope extraction walks the same
+    ``(name, op_name)`` pairs ``scope_op_names`` does)."""
+    global _HLO_INSTR_RE
+    import re
+
+    if _HLO_INSTR_RE is None:
+        _HLO_INSTR_RE = re.compile(
+            r"%([\w.\-]+)\s*=.*?op_name=\"([^\"]*)\""
+        )
+    return _HLO_INSTR_RE
+
+
 def scope_op_names(hlo_text: str,
                    markers: tuple = QUANT_SCOPE_MARKERS) -> set[str]:
     """Instruction names (no ``%``) whose ``metadata={op_name=...}``
@@ -149,15 +166,8 @@ def scope_op_names(hlo_text: str,
     executables, subtract ``hlo_instruction_names`` of the OTHER
     modules from the returned set, or their events get attributed
     here."""
-    global _HLO_INSTR_RE
-    import re
-
-    if _HLO_INSTR_RE is None:
-        _HLO_INSTR_RE = re.compile(
-            r"%([\w.\-]+)\s*=.*?op_name=\"([^\"]*)\""
-        )
     out = set()
-    for m in _HLO_INSTR_RE.finditer(hlo_text):
+    for m in hlo_instr_re().finditer(hlo_text):
         name, op_name = m.group(1), m.group(2)
         if any(mk in op_name for mk in markers):
             out.add(name)
@@ -270,7 +280,8 @@ def _subtract(a: list[tuple[int, int]],
 
 
 def comm_report(trace_dir: str, top_n: int = 15,
-                quant_ops: set | None = None) -> dict:
+                quant_ops: set | None = None,
+                scopes: dict | None = None) -> dict:
     """Parse the newest trace run under ``trace_dir`` into an
     overlap-aware comm/compute attribution.
 
@@ -309,6 +320,16 @@ def comm_report(trace_dir: str, top_n: int = 15,
     as ``quant_s``/``quant_frac`` (share of busy), the compute the
     wire compression COSTS, reported alongside what it saves.  Quant
     events still count as compute in the hidden/exposed split.
+
+    ``scopes`` (the step-phase profiler's generalization,
+    ``obs/profiler.py``): an ORDERED ``{leg_name: set(instruction
+    names)}`` — every event is attributed to the FIRST scope whose
+    set contains its op (first-match-wins, so a nested scope like
+    ``exchange_b0/quantize_wire`` lands in whichever leg the caller
+    lists first), summed into ``scope_s`` (all events) and
+    ``scope_comm_s`` (the collective share), both in core-seconds.
+    Events matching no scope are the unscoped remainder the caller
+    derives from ``device_busy_s``.
     """
     xplane_pb2 = _xplane_pb2()
 
@@ -322,9 +343,18 @@ def comm_report(trace_dir: str, top_n: int = 15,
     per_op_all: dict[str, int] = {}
     quant_ps_box = [0]
     quant_ops = quant_ops or set()
+    scopes = scopes or {}
+    scope_ps = {name: 0 for name in scopes}
+    scope_comm_ps = {name: 0 for name in scopes}
 
     def _record(core, op, s, e, *, comm):
         per_op_all[op] = per_op_all.get(op, 0) + (e - s)
+        for name, ops in scopes.items():     # first match wins
+            if op in ops:
+                scope_ps[name] += e - s
+                if comm:
+                    scope_comm_ps[name] += e - s
+                break
         if comm:
             core["comm"].append((s, e))
             per_op[op] = per_op.get(op, 0) + (e - s)
@@ -436,6 +466,8 @@ def comm_report(trace_dir: str, top_n: int = 15,
             (comm_s - exposed_s) / comm_s if comm_s else 0.0
         ),
         "n_cores": len(cores),
+        "scope_s": {k: v * ps for k, v in scope_ps.items()},
+        "scope_comm_s": {k: v * ps for k, v in scope_comm_ps.items()},
         "top_collectives": [(k, v * ps) for k, v in top],
         "top_ops": [
             (k, v * ps)

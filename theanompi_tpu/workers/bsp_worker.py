@@ -202,6 +202,55 @@ def _build_mesh(devices: Sequence[Any] | None, config: dict | None = None):
     )
 
 
+def _profile_step_phase(model, n_devices: int, verbose: bool) -> dict:
+    """One profiled training window through ``obs.step_profile`` —
+    the worker-side wiring of the step-phase profiler: HLO scope
+    sets from the model's active executable, FLOPs from its cost
+    analysis, peak from the device kind (None off-TPU: the CPU mesh
+    still gets the time decomposition, just no absolute MFU)."""
+    from theanompi_tpu.obs import format_profile, step_profile
+    from theanompi_tpu.utils.scaling_model import (
+        cost_analysis_totals,
+        peak_flops_per_chip,
+    )
+
+    devices = list(model.mesh.devices.flat)
+    peak = peak_flops_per_chip(devices)
+    nb = model.data.n_batch_train
+    k = model.preferred_chunk(nb) if hasattr(
+        model, "preferred_chunk") else 1
+    prof_rec = Recorder(verbose=False)
+
+    def window():
+        if k > 1:
+            model.train_chunk(0, k, prof_rec)
+        else:
+            model.train_iter(0, prof_rec)
+        prof_rec.flush()
+
+    window()    # stage inputs / warm (executables are already warm)
+    hlo = model.train_step_hlo_text()
+    flops = bytes_acc = None
+    try:
+        flops, bytes_acc = cost_analysis_totals(
+            model.train_step_cost_analysis(), n_devices
+        )
+    except Exception:
+        pass
+    prof = step_profile(
+        window, hlo_text=hlo, n_steps=k, n_devices=n_devices,
+        name=type(model).__name__, peak_flops=peak,
+        step_flops=flops or None, step_bytes=bytes_acc or None,
+    )
+    if verbose:
+        print(format_profile(prof), flush=True)
+    return {
+        "profile": prof.as_dict(),
+        "profile_spans": prof.spans(process="bsp_worker"),
+        "profile_counters": prof.counter_tracks(process="bsp_worker"),
+    }
+
+
 def run(
     devices: Sequence[Any] | None = None,
     modelfile: str = "",
@@ -433,6 +482,22 @@ def run(
     # give an in-process host its normal SIGTERM semantics back
     _sup.uninstall_preemption_handler()
 
+    # step-phase profiler (config knob "step_profile", ISSUE 15): one
+    # profiled window AFTER training — per-scope decomposition with
+    # MFU/gap attribution attached to the summary.  Runs extra steps
+    # on the final params (a post-run diagnostic, never on by
+    # default) against a throwaway recorder so the run's telemetry
+    # stays untouched.  A profiler failure is reported, not fatal —
+    # it must not cost a completed multi-hour run its summary.
+    step_prof = None
+    if cfg.get("step_profile") and not preempted:
+        try:
+            step_prof = _profile_step_phase(model, n_devices, verbose)
+        except Exception as e:  # pragma: no cover - diagnostic path
+            step_prof = {"error": f"{type(e).__name__}: {e}"}
+            if verbose:
+                print(f"step_profile failed: {e}", flush=True)
+
     trace_spans = None
     if tracer is not None:
         recorder.finish_trace()
@@ -440,10 +505,21 @@ def run(
         if cfg.get("trace_export"):
             from theanompi_tpu.obs import write_chrome_trace
 
-            write_chrome_trace(tracer.spans(), cfg["trace_export"])
+            # the StepProfile rides the SAME export as the iteration
+            # spans — phase tree + counter tracks in one Perfetto view
+            spans = tracer.spans()
+            counters = None
+            if isinstance(step_prof, dict) and "profile" in step_prof:
+                spans = spans + step_prof["profile_spans"]
+                counters = step_prof["profile_counters"]
+            write_chrome_trace(spans, cfg["trace_export"],
+                               counters=counters)
             if verbose:
                 print(f"trace: {trace_spans} spans -> "
                       f"{cfg['trace_export']}", flush=True)
+    if isinstance(step_prof, dict):
+        # the span/counter payloads only ride the export file
+        step_prof = step_prof.get("profile", step_prof)
 
     last_val = recorder.val_records[-1] if recorder.val_records else {}
     return {
@@ -473,6 +549,7 @@ def run(
         "elastic_resume": elastic_note,
         "resharded": bool(resharded),
         "trace_spans": trace_spans,
+        "step_profile": step_prof,
         "recorder": recorder,
         "model": model,
     }
