@@ -48,6 +48,12 @@
 #    than the full row's 2% — ~1 s windows on a 2-core host are
 #    scheduler-noise-bound).  Then `bench_diff --gate` must run
 #    GREEN over the repo's real BENCH_* trajectory.
+# 8. loader (ISSUE 16): the streaming-loader data-plane row — the
+#    sync-vs-pipelined WResNet A/B child self-asserts bitwise-equal
+#    losses, StepProfile coverage, pipelined exposed data wait ≈ 0,
+#    host_gap no worse than the synchronous arm's, the
+#    stall_loader starvation degrade, and the elastic 8→4 sample-id
+#    accounting; this gate re-asserts the reported fields landed.
 #
 # Usage: bash scripts/bench_smoke.sh
 
@@ -265,6 +271,42 @@ if not (prof.get("gap") or {}).get("legs"):
     sys.exit("bench_smoke: gap attribution missing named legs: %s"
              % prof.get("gap"))
 print("bench_smoke: profile OK")
+'
+
+# 8. streaming-loader data plane (ISSUE 16): A/B + drills, all
+#    asserted in the child; re-assert the row surfaced them.
+out=$(TM_BENCH_MODEL=loader python bench.py)
+printf '%s\n' "$out" | python -c '
+import json, sys
+row = json.loads(sys.stdin.readline())
+ab = row.get("pipeline_ab") or {}
+print("loader A/B bitwise", ab.get("bitwise_equal"),
+      "wait sync/pipelined", ab.get("wait_frac_sync"),
+      ab.get("wait_frac_pipelined"),
+      "starved", ab.get("starved"),
+      "elastic", ab.get("elastic_8to4"))
+if "error" in ab:
+    sys.exit("bench_smoke: loader pipeline A/B errored: %s"
+             % ab["error"])
+if ab.get("bitwise_equal") is not True:
+    sys.exit("bench_smoke: pipelined feed not bitwise-equal to the "
+             "synchronous feed: %s" % ab)
+if not ab.get("wait_frac_pipelined", 1.0) <= 0.05:
+    sys.exit("bench_smoke: pipelined feed exposed data wait not "
+             "within noise of zero: %s" % ab)
+if not (ab.get("starved") or 0) >= 1:
+    sys.exit("bench_smoke: starvation drill recorded no degrade: %s"
+             % ab)
+el = ab.get("elastic_8to4") or {}
+if el.get("lost") != 0 or el.get("dup") != 0 \
+        or el.get("worlds") != [8, 4]:
+    sys.exit("bench_smoke: elastic 8->4 sample accounting off: %s"
+             % el)
+sub = row.get("subrows") or {}
+if not ("sync" in sub and "pipelined" in sub):
+    sys.exit("bench_smoke: loader row carried no sync/pipelined "
+             "subrows: %s" % sorted(sub))
+print("bench_smoke: loader OK")
 '
 
 python scripts/bench_diff.py --gate

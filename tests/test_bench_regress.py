@@ -132,9 +132,15 @@ class TestRealTrajectoryGatesClean:
         assert j["capture"] == history[-1]["name"]
         assert j["verdict"] == "ok", j["rows"]
         assert j["regressed"] == []
-        # the serving rows were actually judged, not skipped
+        # the serving rows were actually judged, not skipped —
+        # truncate to the newest capture CARRYING them (later
+        # captures may be partial, e.g. the r10 loader-only capture)
+        while history and "serving" not in history[-1]["rows"]:
+            history.pop()
+        js = regress.judge_capture(history)
+        assert js["verdict"] == "ok", js["rows"]
         judged = {
-            n for n, v in j["rows"].items()
+            n for n, v in js["rows"].items()
             if v["verdict"] in ("ok", "improved")
         }
         assert {"serving", "serving_paged", "serving_fleet",
@@ -227,6 +233,45 @@ class TestSyntheticVerdicts:
         )
         assert j2["rows"]["row"]["verdict"] == "improved"
 
+    def test_platform_boundary_judges_as_new(self):
+        """A row that declares a platform never compares against a
+        different (or undeclared) platform's values: the r05 native
+        loader ran on the chip-attached host at ~2900 img/s, the
+        cpu-container capture reads ~1650 — two machines, not a 43%
+        regression.  A platform-less row (legacy captures, the
+        in-flight record) stays wildcard and compares as before."""
+        chip = _row(2900.0, unit="images/sec", spread=0.02)
+        cont = dict(_row(1650.0, unit="images/sec", spread=0.02),
+                    platform="cpu-container")
+        j = regress.judge_capture(
+            [_cap("r05", {"row": chip})], _cap("r10", {"row": cont})
+        )
+        assert j["rows"]["row"]["verdict"] == "new"
+        # same declared platform on both sides: judged normally
+        prev = dict(chip, platform="cpu-container")
+        j2 = regress.judge_capture(
+            [_cap("r09", {"row": prev})], _cap("r10", {"row": cont})
+        )
+        assert j2["rows"]["row"]["verdict"] == "regressed"
+        # wildcard current row (no platform) compares against anything
+        j3 = regress.judge_capture(
+            [_cap("r09", {"row": prev})],
+            _cap("r10", {"row": _row(1650.0, unit="images/sec",
+                                     spread=0.02)})
+        )
+        assert j3["rows"]["row"]["verdict"] == "regressed"
+        # and the band learned from history skips the cross-platform
+        # jump (a machine change is not accepted noise)
+        hist = [_cap("r04", {"row": _row(5000.0, unit="images/sec")}),
+                _cap("r05", {"row": chip}),
+                _cap("r09", {"row": prev})]
+        j4 = regress.judge_capture(
+            hist, _cap("r10", {"row": dict(cont, value=2800.0)})
+        )
+        v = j4["rows"]["row"]
+        assert v["vs"] == "r09"
+        assert v["band"] == regress.BAND_FLOOR
+
     def test_new_row_never_gates(self):
         hist = self._history([100.0])
         j = regress.judge_capture(
@@ -291,7 +336,10 @@ class TestHeadlineRegressField:
         on-disk serving capture reports itself regressed."""
         from bench import _headline_line
 
-        newest = regress.load_history(ROOT)[-1]
+        # newest capture CARRYING a serving row (later captures may
+        # be partial — r10 carries only the loader row)
+        newest = [c for c in regress.load_history(ROOT)
+                  if "serving" in c["rows"]][-1]
         prev = newest["rows"]["serving"]["value"]
         rec = {"metric": "x", "value": None, "unit": None,
                "secondary": {"serving": {

@@ -1,0 +1,424 @@
+"""The data plane (ISSUE 16): streaming loader, elastic shard
+cursors, the staging discipline, and the serving-side tokenize
+batching service.
+
+The sharp invariant everywhere: the pipeline changes WHERE host work
+happens, never WHAT trains — the pipelined stream is bitwise-equal
+to the synchronous feed (the permutation, not the transport, defines
+batch order), starvation degrades to a synchronous fetch instead of
+a deadlock, and the w-of-n stride partition covers every sample
+exactly once at any world size.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from theanompi_tpu.data import (
+    HostStager,
+    ShardedBatches,
+    StreamingLoader,
+    coverage_check,
+    resolve_loader_depth,
+    shard_ids,
+)
+from theanompi_tpu.parallel import DATA_AXIS, make_mesh
+from theanompi_tpu.utils import Recorder
+
+
+# -- config knob ------------------------------------------------------------
+
+
+class TestResolveLoaderDepth:
+    @pytest.mark.parametrize("raw,want", [
+        (None, 0), (False, 0), (0, 0), (True, 2), (2, 2), (5, 5),
+    ])
+    def test_valid_values(self, raw, want):
+        assert resolve_loader_depth({"loader_pipeline": raw}) == want
+
+    def test_absent_means_synchronous(self):
+        assert resolve_loader_depth({}) == 0
+
+    @pytest.mark.parametrize("raw", [1, -1, "fast"])
+    def test_invalid_values_refuse(self, raw):
+        with pytest.raises(ValueError):
+            resolve_loader_depth({"loader_pipeline": raw})
+
+
+# -- StreamingLoader (host-only: identity stage) ----------------------------
+
+
+def _ident_loader(n=8, **kw):
+    def fetch(i):
+        return (np.full((2,), i, np.float32),)
+
+    return StreamingLoader(
+        fetch, lambda b: b, n_batches=lambda: n, **kw
+    )
+
+
+class TestStreamingLoader:
+    def test_sequential_delivery_rides_the_ring(self):
+        ld = _ident_loader(8)
+        got = [int(ld.next(i)[0][0]) for i in range(8)]
+        ld.stop()
+        assert got == list(range(8))
+        assert ld.staged >= 1 and ld.starved == 0
+
+    def test_out_of_sequence_index_resyncs(self):
+        # epoch wrap / mid-epoch resume: any jump realigns the
+        # producer — the delivered batch is always batch i
+        ld = _ident_loader(8)
+        seq = [0, 1, 5, 6, 0, 1]
+        got = [int(ld.next(i)[0][0]) for i in seq]
+        ld.stop()
+        assert got == seq
+
+    def test_starved_consumer_degrades_to_synchronous_fetch(self):
+        slow = {"armed": True}
+
+        def fetch(i):
+            if i == 3 and slow.pop("armed", False):
+                time.sleep(0.5)
+            return (np.full((2,), i, np.float32),)
+
+        ld = StreamingLoader(
+            fetch, lambda b: b, n_batches=lambda: 8,
+            depth=2, timeout_s=0.1,
+        )
+        got = [int(ld.next(i)[0][0]) for i in range(8)]
+        ld.stop()
+        assert got == list(range(8))   # sequence intact, no deadlock
+        assert ld.starved >= 1
+
+    def test_ring_depth_below_two_refuses(self):
+        with pytest.raises(ValueError):
+            _ident_loader(8, depth=1)
+
+    def test_cursor_counts_in_sample_units(self):
+        ld = _ident_loader(8, global_batch=32)
+        for i in range(3):
+            ld.next(i)
+        cur = ld.cursor()
+        ld.stop()
+        assert cur["next_iter"] == 3
+        assert cur["next_sample"] == 3 * 32
+        assert cur["global_batch"] == 32
+        assert cur["staged"] + cur["starved"] == 3
+
+    def test_journal_records_delivered_sample_ids(
+            self, tmp_path, monkeypatch):
+        jpath = tmp_path / "journal.jsonl"
+        monkeypatch.setenv("TM_LOADER_JOURNAL", str(jpath))
+        perm = np.arange(16)[::-1]
+        ld = StreamingLoader(
+            lambda i: (np.zeros((2,), np.float32),),
+            lambda b: b,
+            n_batches=lambda: 4,
+            global_batch=4,
+            sample_ids=lambda i: perm[i * 4:(i + 1) * 4],
+            journal_meta=lambda: {"epoch": 1, "world": 8, "worker": 0},
+        )
+        for i in range(4):
+            ld.next(i)
+        ld.stop()
+        entries = [json.loads(l) for l in open(jpath)]
+        assert [e["iter"] for e in entries] == [0, 1, 2, 3]
+        assert all(e["epoch"] == 1 and e["world"] == 8 for e in entries)
+        assert sorted(s for e in entries for s in e["ids"]) == list(
+            range(16)
+        )
+
+
+# -- elastic shard cursors --------------------------------------------------
+
+
+class _SynthData:
+    def __init__(self, n=64, gb=8, seed=7):
+        self._train_x = np.arange(n, dtype=np.float32)
+        self._train_y = np.arange(n, dtype=np.int32)
+        self.global_batch = gb
+        self.n_batch_train = n // gb
+        self._perm = np.random.default_rng(seed).permutation(n)
+
+    def batch_indices(self, i):
+        gb = self.global_batch
+        return self._perm[i * gb:(i + 1) * gb]
+
+    def train_batch(self, i):
+        sel = self.batch_indices(i)
+        return self._train_x[sel], self._train_y[sel]
+
+
+class TestElasticSharding:
+    @pytest.mark.parametrize("n", [1, 2, 4, 8])
+    def test_stride_partition_invariant(self, n):
+        ids = np.random.default_rng(0).permutation(40)
+        parts = [shard_ids(ids, w, n) for w in range(n)]
+        assert sorted(s for p in parts for s in p) == sorted(ids)
+
+    def test_out_of_range_worker_refuses(self):
+        with pytest.raises(ValueError):
+            shard_ids(np.arange(8), 4, 4)
+        with pytest.raises(ValueError):
+            ShardedBatches(_SynthData(), 2, 2)
+
+    def test_sharded_view_slices_the_global_window(self):
+        d = _SynthData(64, 8)
+        sb = ShardedBatches(d, 1, 4)
+        x, y = sb.train_batch(2)
+        want = d.batch_indices(2)[1::4]
+        assert x.tolist() == want.astype(np.float32).tolist()
+        assert sb.n_batch_train == d.n_batch_train
+        assert sb.global_batch == d.global_batch
+
+    def test_coverage_check_clean_across_reshard(self):
+        # first half of the epoch fed at world 8, second at world 4:
+        # the union per window is still the exact permutation window
+        d = _SynthData(64, 8)
+        entries = []
+        for world, iters in ((8, range(0, 4)), (4, range(4, 8))):
+            for w in range(world):
+                sb = ShardedBatches(d, w, world)
+                for i in iters:
+                    entries.append({
+                        "epoch": 0, "iter": i, "world": world,
+                        "worker": w,
+                        "ids": [int(s) for s in sb.batch_indices(i)],
+                    })
+        lost, dup = coverage_check(
+            entries, global_batch=d.global_batch,
+            n_batch_train=d.n_batch_train,
+            perm_for_epoch=lambda e: d._perm,
+        )
+        assert not lost and not dup
+
+    def test_coverage_check_catches_lost_and_duplicated(self):
+        d = _SynthData(64, 8)
+        entries = [{
+            "epoch": 0, "iter": 0, "world": 2, "worker": w,
+            "ids": [int(s) for s in ShardedBatches(
+                d, w, 2).batch_indices(0)],
+        } for w in range(2)]
+        lost, _ = coverage_check(
+            entries[:1], global_batch=d.global_batch,
+            n_batch_train=d.n_batch_train,
+            perm_for_epoch=lambda e: d._perm,
+        )
+        assert len(lost) == 4          # worker 1's stride went missing
+        _, dup = coverage_check(
+            entries + entries[:1], global_batch=d.global_batch,
+            n_batch_train=d.n_batch_train,
+            perm_for_epoch=lambda e: d._perm,
+        )
+        assert len(dup) == 4           # worker 0 delivered twice
+
+
+# -- HostStager (device staging discipline) ---------------------------------
+
+
+class TestHostStager:
+    def test_stage_is_bitwise_and_sharded(self, devices8):
+        mesh = make_mesh(data=8, devices=devices8)
+        st = HostStager(NamedSharding(mesh, P(DATA_AXIS)))
+        assert st.hlo_text() is None   # shapes unknown pre-stage
+        x = np.random.default_rng(0).normal(
+            size=(32, 3)).astype(np.float32)
+        y = np.arange(32, dtype=np.int32)
+        ox, oy = st.stage((x, y))
+        assert np.array_equal(np.asarray(ox), x)
+        assert np.array_equal(np.asarray(oy), y)
+        assert ox.sharding.spec == P(DATA_AXIS)
+        assert st.hlo_text() is not None
+
+    def test_dtype_casts_apply_host_side(self, devices8):
+        mesh = make_mesh(data=8, devices=devices8)
+        st = HostStager(
+            NamedSharding(mesh, P(DATA_AXIS)),
+            dtypes=("int32", None),
+        )
+        ids = np.arange(16, dtype=np.int64).reshape(16, 1)
+        out, _ = st.stage((ids, np.zeros((16,), np.float32)))
+        assert out.dtype == np.int32
+
+
+# -- model-level feed (WResNet, the worker loops' path) ---------------------
+
+
+_WRN = {
+    "batch_size": 4, "depth": 10, "widen": 1, "n_train": 4 * 8 * 2,
+    "n_val": 32, "n_epochs": 1, "lr": 0.01, "seed": 3,
+}
+
+
+def _wresnet(devices8, extra=None):
+    from theanompi_tpu.models.wresnet import WResNet
+
+    m = WResNet(dict(_WRN, **(extra or {})))
+    m.build_model(n_replicas=8)
+    m.compile_iter_fns(
+        mesh=make_mesh(data=8, devices=devices8), exch_strategy="ar"
+    )
+    return m
+
+
+def _losses(m, k):
+    rec = Recorder(verbose=False)
+    nb = m.data.n_batch_train
+    for i in range(k):
+        m.train_iter(i % nb, rec)
+    rec.flush()
+    return [float(x) for x in rec.train_losses]
+
+
+class TestModelFeed:
+    def test_pipelined_feed_is_bitwise_equal_to_sync(self, devices8):
+        sync = _losses(_wresnet(devices8), 4)
+        m = _wresnet(devices8, {"loader_pipeline": 2})
+        assert m._feed is not None
+        pipe = _losses(m, 4)
+        m.close_feed()
+        assert sync == pipe
+
+    def test_checkpoint_stamps_loader_cursor(self, devices8, tmp_path):
+        from theanompi_tpu.utils.checkpoint import (
+            checkpoint_meta, latest_checkpoint,
+        )
+
+        m = _wresnet(devices8, {"loader_pipeline": 2})
+        _losses(m, 2)
+        m.save(str(tmp_path))
+        m.close_feed()
+        cur = checkpoint_meta(
+            latest_checkpoint(str(tmp_path)))["loader_cursor"]
+        assert cur["next_iter"] == 2
+        assert cur["next_sample"] == 2 * m.data.global_batch
+
+    def test_feed_declines_device_resident_paths(self, devices8):
+        # the HBM dataset cache moves zero bytes per step — a
+        # streaming feed behind it would only burn a thread
+        m = _wresnet(devices8, {"loader_pipeline": 2})
+        m.close_feed()
+        m._device_cache = (None, None)
+        with pytest.warns(UserWarning, match="device_data_cache"):
+            m._init_feed(m._data_sharding)
+        assert m._feed is None
+
+    def test_close_feed_is_idempotent(self, devices8):
+        m = _wresnet(devices8, {"loader_pipeline": 2})
+        m.close_feed()
+        m.close_feed()
+        assert m._feed is None
+
+
+# -- serving-side tokenize batching service ---------------------------------
+
+
+class TestByteTokenizer:
+    def test_round_trip_unicode(self):
+        from theanompi_tpu.serving import ByteTokenizer
+
+        tok = ByteTokenizer()
+        text = "héllo, wörld — ¿tokens?"
+        assert tok.decode(tok.encode(text)) == text
+        assert min(tok.encode(text)) >= tok.offset
+
+    def test_specials_below_offset_drop_on_decode(self):
+        from theanompi_tpu.serving import ByteTokenizer
+
+        tok = ByteTokenizer()
+        ids = [0, 1] + tok.encode("ab") + [2]
+        assert tok.decode(ids) == "ab"
+
+
+class TestTokenizeService:
+    def test_concurrent_submissions_batch_naturally(self):
+        from theanompi_tpu.serving import ByteTokenizer, TokenizeService
+        from theanompi_tpu.utils import ServingRecorder
+
+        rec = ServingRecorder()
+        svc = TokenizeService(ByteTokenizer(), recorder=rec)
+        futs, texts = [], [f"request {i}" for i in range(24)]
+        threads = [
+            threading.Thread(
+                target=lambda t=t: futs.append(svc.encode_async(t))
+            )
+            for t in texts
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        got = {tuple(f.result(timeout_s=10.0)) for f in futs}
+        svc.stop()
+        tok = ByteTokenizer()
+        assert got == {tuple(tok.encode(t)) for t in texts}
+        s = svc.stats()
+        assert s["items"] == 24
+        # natural batching: fewer sweeps than items (the worker's
+        # busy time accumulates the next sweep's batch)
+        assert 1 <= s["sweeps"] <= 24
+        assert rec.summary()["tokenize_items"] == 24
+
+    def test_blocking_wrappers_round_trip(self):
+        from theanompi_tpu.serving import ByteTokenizer, TokenizeService
+
+        svc = TokenizeService(ByteTokenizer())
+        ids = svc.tokenize("stream me")
+        assert svc.detokenize(ids) == "stream me"
+        svc.stop()
+
+    def test_post_stop_submissions_fail_fast(self):
+        from theanompi_tpu.serving import ByteTokenizer, TokenizeService
+
+        svc = TokenizeService(ByteTokenizer())
+        svc.stop()
+        with pytest.raises(RuntimeError):
+            svc.tokenize("late")
+
+
+class TestEngineTextPath:
+    def test_submit_text_requires_tokenizer(self, devices8):
+        from theanompi_tpu.serving import Engine
+
+        eng = Engine(_tiny_decoder(devices8))
+        with pytest.raises(RuntimeError, match="tokenizer"):
+            eng.submit_text("hi")
+        with pytest.raises(RuntimeError, match="tokenizer"):
+            eng.decode_text([5, 6])
+        eng.stop()
+
+    def test_submit_text_serves_and_decodes(self, devices8):
+        from theanompi_tpu.serving import ByteTokenizer, Engine
+
+        eng = Engine(
+            _tiny_decoder(devices8), tokenizer=ByteTokenizer()
+        )
+        f = eng.submit_text("hi", max_tokens=4)
+        eng.run_until_idle()
+        r = f.result(timeout=0)
+        assert r.status == "ok"
+        assert isinstance(eng.decode_text(r.tokens), str)
+        assert eng.recorder.summary()["tokenize_items"] >= 2
+        eng.stop()
+
+
+def _tiny_decoder(devices8):
+    from theanompi_tpu.models.llama import Llama
+
+    m = Llama(dict(
+        dim=32, n_layers=2, n_heads=4, n_kv_heads=2, ffn_dim=64,
+        vocab=272, seq_len=64, batch_size=4, lr=1e-2, n_train=64,
+        n_val=32, compute_dtype="float32", remat=False, tp=1,
+    ))
+    m.build_model(n_replicas=1)
+    m.compile_iter_fns(
+        mesh=make_mesh(data=1, model=1, devices=devices8[:1])
+    )
+    return m.make_decoder(max_slots=2, max_seq=48)

@@ -311,7 +311,7 @@ _ELASTIC_CFG = dict(
 
 
 def _elastic_launch(ckpt, n_epochs, *, fault_at=None, resume=False,
-                    max_restarts=3):
+                    max_restarts=3, extra_cfg=None, extra_env=None):
     from theanompi_tpu import launcher
 
     env = dict(os.environ)
@@ -326,13 +326,17 @@ def _elastic_launch(ckpt, n_epochs, *, fault_at=None, resume=False,
         env["TM_FAULT_AT"] = fault_at
     else:
         env.pop("TM_FAULT_AT", None)
+    env.pop("TM_LOADER_JOURNAL", None)
+    if extra_env:
+        env.update(extra_env)
     return launcher.launch(
         "theanompi_tpu.workers.bsp_worker",
         devices=list(range(8)),
         modelfile="theanompi_tpu.models.llama",
         modelclass="Llama",
         rule_kwargs=dict(
-            config=dict(_ELASTIC_CFG, n_epochs=n_epochs),
+            config=dict(_ELASTIC_CFG, n_epochs=n_epochs,
+                        **(extra_cfg or {})),
             checkpoint_dir=str(ckpt),
             resume=resume,
             verbose=True,
@@ -427,3 +431,149 @@ class TestElasticWorldResize:
         assert fhb["resharded"] is True  # dp=4 checkpoint regathered
         rec2 = _final_elastic_recorder(ckpt)
         assert len(rec2["train_losses"]) == (n_epochs + 2) * nb
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 16: the data plane under faults — a stalled producer degrades
+# (never deadlocks, never reorders), and the pipelined feed rides an
+# elastic 8 -> 4 reshard with every sample delivered exactly once
+# ---------------------------------------------------------------------------
+
+
+_STALL_CFG = dict(
+    batch_size=4, depth=10, widen=1, n_train=4 * 8 * 4, n_val=32,
+    n_epochs=1, lr=0.01, seed=3, lr_schedule=None,
+)
+
+
+def _stall_run(monkeypatch, fault_at=None, stall_n=2):
+    from theanompi_tpu.utils import faults
+    from theanompi_tpu.workers import bsp_worker
+
+    if fault_at:
+        monkeypatch.setenv("TM_FAULT_AT", fault_at)
+        monkeypatch.setenv("TM_STALL_LOADER_N", str(stall_n))
+    else:
+        monkeypatch.delenv("TM_FAULT_AT", raising=False)
+    monkeypatch.delenv("TM_FAULT_STATE", raising=False)
+    faults.reset_fault_cache()
+    try:
+        return bsp_worker.run(
+            devices=list(range(8)),
+            modelfile="theanompi_tpu.models.wresnet",
+            modelclass="WResNet",
+            config=dict(_STALL_CFG, loader_pipeline=2),
+            verbose=False,
+        )
+    finally:
+        monkeypatch.delenv("TM_FAULT_AT", raising=False)
+        faults.reset_fault_cache()
+
+
+@pytest.mark.slow
+@pytest.mark.fault_matrix
+class TestLoaderStallDrill:
+    def test_stalled_producer_degrades_bitwise(self, monkeypatch):
+        """``stall_loader`` freezes the producer for N batches
+        mid-epoch: the consumer's timeout path must tick ``starved``
+        and fetch synchronously — same batches, same order, losses
+        BITWISE equal to an unstalled pipelined run."""
+        # inject after iter 0: the depth-2 ring holds iters 1-2 and
+        # the producer is parked on a full ring with iter 3 (the LAST
+        # window) still unfetched, so the stall is always consumed —
+        # one iter later the producer has prefetched the whole epoch
+        # and the drill would assert on a no-op
+        clean = _stall_run(monkeypatch)
+        stalled = _stall_run(
+            monkeypatch, fault_at="0:0:stall_loader", stall_n=2
+        )
+        assert stalled["loader"] is not None
+        assert stalled["loader"]["starved"] >= 1
+        assert clean["loader"]["starved"] == 0
+        a = [float(x) for x in clean["recorder"].train_losses]
+        b = [float(x) for x in stalled["recorder"].train_losses]
+        assert a == b
+
+
+@pytest.mark.slow
+@pytest.mark.fault_matrix
+class TestElasticPipelinedFeed:
+    def test_shrink_world_mid_epoch_zero_lost_zero_dup(
+            self, tmp_path, monkeypatch):
+        """The ISSUE 16 elastic drill: a supervised 8-way run with the
+        PIPELINED feed loses half its capacity mid-epoch
+        (``shrink_world`` at epoch 1 iter 1) and resumes at dp=4.
+        World history [8, 4]; the loader journal's FINAL delivery per
+        (epoch, iter) window covers each permutation window exactly —
+        zero lost, zero duplicated sample ids; the loss curve matches
+        an uninterrupted equal-global-batch reference at rtol 1e-2."""
+        from theanompi_tpu.data import coverage_check
+        from theanompi_tpu.models.data.lm_synthetic import (
+            MarkovLMData,
+        )
+
+        monkeypatch.delenv("TM_LOADER_JOURNAL", raising=False)
+        ckpt = tmp_path / "ck"
+        jpath = tmp_path / "journal.jsonl"
+        n_epochs, nb = 3, 4
+        h = _elastic_launch(
+            ckpt, n_epochs, fault_at="1:1:shrink_world",
+            extra_cfg={"loader_pipeline": 2},
+            extra_env={"TM_LOADER_JOURNAL": str(jpath)},
+        )
+        report = h.wait()
+        assert report["completed"]
+        assert report["world_size_history"] == [8, 4]
+
+        entries = [json.loads(l) for l in open(jpath)]
+        assert entries, "pipelined feed wrote no journal"
+        worlds = sorted({e["world"] for e in entries})
+        assert worlds == [4, 8]
+        # the relaunch REPLAYS the interrupted epoch from its last
+        # checkpoint (non-graceful death), so keep each window's
+        # FINAL delivery — the stream the finished run trained on
+        final = {}
+        for e in entries:
+            final[(e["epoch"], e["iter"])] = e
+        data = MarkovLMData(
+            vocab=_ELASTIC_CFG["vocab"],
+            seq_len=_ELASTIC_CFG["seq_len"],
+            batch_size=_ELASTIC_CFG["batch_size"],
+            n_train=_ELASTIC_CFG["n_train"],
+            n_val=_ELASTIC_CFG["n_val"],
+            n_replicas=8,
+            seed=42,  # the Llama config default — perm must match
+        )
+
+        def perm_for_epoch(epoch):
+            data.shuffle(epoch)
+            return data.epoch_permutation()
+
+        lost, dup = coverage_check(
+            list(final.values()),
+            global_batch=16,
+            n_batch_train=nb,
+            perm_for_epoch=perm_for_epoch,
+        )
+        assert not lost and not dup, (lost[:5], dup[:5])
+        # every epoch's full window set was delivered
+        assert sorted({k[0] for k in final}) == list(range(n_epochs))
+
+        # trajectory: matches the uninterrupted dp=8 reference
+        from theanompi_tpu.workers import bsp_worker
+
+        rec = _final_elastic_recorder(ckpt)
+        losses = np.asarray(rec["train_losses"], np.float64)
+        assert len(losses) == n_epochs * nb
+        ref = bsp_worker.run(
+            devices=list(range(8)),
+            modelfile="theanompi_tpu.models.llama",
+            modelclass="Llama",
+            config=dict(_ELASTIC_CFG, n_epochs=n_epochs),
+            verbose=False,
+        )
+        np.testing.assert_allclose(
+            losses,
+            np.asarray(ref["recorder"].train_losses, np.float64),
+            rtol=1e-2, atol=1e-3,
+        )

@@ -562,3 +562,43 @@ def test_speculation_speedup_forms():
             "tokens_per_step"] == 5.0
         assert sm.speculation_speedup(k=5, accept_rate=0.0, **kw)[
             "speedup"] == 1.0
+
+
+def test_loader_pipeline_predictor():
+    from theanompi_tpu.utils import scaling_model as sm
+
+    # compute-bound: host work fits under the step — pipelined
+    # host_gap is exactly zero and the win is the whole host leg
+    r = sm.loader_pipeline(
+        batch_bytes=32 * 3 * 32 * 32 * 4, step_time_s=0.1,
+        host_bw=2e9,
+    )
+    assert not r["producer_bound"]
+    assert r["host_gap_frac_pipelined"] == 0.0
+    assert r["t_step_pipelined_ms"] == pytest.approx(100.0)
+    assert r["overlap_win_ms"] == pytest.approx(r["t_host_ms"])
+    assert 0.0 < r["host_gap_frac_sync"] < 1.0
+
+    # producer-bound: host work exceeds the step — the exposed
+    # remainder is priced, and more ring depth cannot hide it
+    b = sm.loader_pipeline(
+        batch_bytes=4e9, step_time_s=0.1, host_bw=2e9, fetch_s=0.05,
+    )
+    assert b["producer_bound"]
+    assert b["t_step_pipelined_ms"] == pytest.approx(
+        b["t_host_ms"]
+    )
+    assert b["starved_frac"] > 0.5
+
+    # sync cost is monotone in fetch time; the pipelined arm only
+    # pays what the step cannot cover
+    lo = sm.loader_pipeline(
+        batch_bytes=1e6, step_time_s=0.1, fetch_s=0.0)
+    hi = sm.loader_pipeline(
+        batch_bytes=1e6, step_time_s=0.1, fetch_s=0.02)
+    assert hi["t_step_sync_ms"] > lo["t_step_sync_ms"]
+    assert hi["t_step_pipelined_ms"] == lo["t_step_pipelined_ms"]
+
+    with pytest.raises(ValueError):
+        sm.loader_pipeline(
+            batch_bytes=1e6, step_time_s=0.1, depth=1)

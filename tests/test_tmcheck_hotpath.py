@@ -360,3 +360,49 @@ class TestTracerSpans:
                     return handle
         """)
         assert out == []
+
+
+class TestLoaderProducerFences:
+    """The streaming loader's producer loop (TM104 seeds "next"/
+    "_produce", ISSUE 16): the whole point of the producer thread is
+    fetch+stage UNDER the previous step's compute, so a per-batch
+    value readback inside it re-serializes exactly what the pipeline
+    overlapped — the PR 6 fence bug class relocated to the feed."""
+
+    def test_per_batch_float_fence_in_producer_flagged(self):
+        out = run("""
+            class Loader:
+                def _produce(self):
+                    while True:
+                        batch = self._fetch(self._next_prod)
+                        staged = self._stage_jit(batch)
+                        self._checksum += float(staged[0])
+                        self._ring.append(staged)
+        """)
+        assert rules_of(out) == ["TM104"]
+        assert "per-iteration float() fence" in out[0].message
+
+    def test_stage_without_value_read_clean(self):
+        # the shipped shape: stage and enqueue — the ring bounds
+        # in-flight transfers by COUNT, never by a host fence
+        out = run("""
+            class Loader:
+                def _produce(self):
+                    while True:
+                        batch = self._fetch(self._next_prod)
+                        staged = self._stage_jit(batch)
+                        self._ring.append(staged)
+        """)
+        assert out == []
+
+    def test_consumer_next_is_seeded_hot(self):
+        # "next" (HOT_EXACT): a consumer that blocks on the staged
+        # value itself — rather than popping the ring — is flagged
+        out = run("""
+            class Loader:
+                def next(self, i):
+                    staged = self._stage_jit(self._fetch(i))
+                    staged[0].block_until_ready()
+                    return staged
+        """)
+        assert rules_of(out) == ["TM104"]

@@ -61,9 +61,18 @@ GUARDED_BY: dict[str, tuple[str | None, frozenset]] = {
 #: bodies must stay host-pure, and a device value fenced into a span
 #: attribute at a call site in a hot function is the same
 #: per-iteration round trip TM104 exists for (fixture-tested).
+#: The streaming loader's consumer/producer pair (data/pipeline.py
+#: ``next``/``_produce``) is seeded because the pipeline only
+#: overlaps if NEITHER side ever fences: one ``block_until_ready`` or
+#: ``.item()`` in the producer serializes every staged transfer
+#: behind a host round trip — exactly the per-batch host fence the
+#: TM104 fixture pins (the PR 6 per-chunk ``int()`` lesson, applied
+#: to data).  ``next`` also covers ``NativeBatchLoader.next``
+#: (native/__init__.py), whose body is host-pure by construction.
 HOT_EXACT = frozenset({
     "step", "decode", "decode_step", "prefill", "verify", "draft",
     "span", "start_span", "end_span", "record_span",
+    "next", "_produce",
 })
 #: … and substrings (catches `_advance_prefill_slot`,
 #: `_prepare_decode_writes`, `_spec_decode_once`, `_verify_body` and
@@ -114,6 +123,9 @@ PROFILE_SCOPES: dict[str, str] = {
     "serving_sample": "sample",
     "paged_attend": "attend",
     "kv_write": "kv_write",
+    # host→device batch staging (data/pipeline.py HostStager, PR 16):
+    # the residual feed cost the streaming loader can't hide
+    "host_load": "host_load",
 }
 
 #: label PREFIX -> leg family: labels carrying a per-instance index

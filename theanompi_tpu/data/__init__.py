@@ -1,0 +1,31 @@
+"""The data plane: streaming host→device input pipeline.
+
+``pipeline`` — :class:`HostStager` (the one copy of the async
+device-put + ``host_load``-labelled staging discipline) and
+:class:`StreamingLoader` (producer-thread ring of device-resident
+batches, the drop-in ``next()`` for the worker loops).
+``sharding`` — :class:`ShardedBatches` (worker-w-of-n stride views)
+and the journal :func:`coverage_check` behind the elastic drills.
+"""
+
+from theanompi_tpu.data.pipeline import (
+    HostStager,
+    StreamingLoader,
+    engine_feed,
+    resolve_loader_depth,
+)
+from theanompi_tpu.data.sharding import (
+    ShardedBatches,
+    coverage_check,
+    shard_ids,
+)
+
+__all__ = [
+    "HostStager",
+    "StreamingLoader",
+    "ShardedBatches",
+    "coverage_check",
+    "engine_feed",
+    "resolve_loader_depth",
+    "shard_ids",
+]

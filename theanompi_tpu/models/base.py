@@ -189,6 +189,13 @@ class TMModel:
         gb = getattr(getattr(self, "data", None), "global_batch", None)
         if gb is not None:
             meta["global_batch"] = int(gb)
+        # stream cursor (elastic resume of the pipelined feed): epoch +
+        # next SAMPLE offset identify the stream position exactly — the
+        # permutation is derived state (shuffle(epoch) reseeds it), and
+        # sample units survive an elastic global-batch regrid
+        feed = getattr(self, "_feed", None)
+        if feed is not None:
+            meta["loader_cursor"] = dict(feed.cursor(), epoch=self.epoch)
         if recorder is not None:
             meta["recorder"] = recorder.state_dict()
         if extra_meta:
@@ -508,6 +515,77 @@ class TMModel:
             recorder.load_state_dict(meta["recorder"])
         self._place_restored()
         return True
+
+    # -- streaming feed (theanompi_tpu/data: the data plane) --------------
+
+    def _init_feed(self, sharding, dtypes=None) -> None:
+        """Build the host→device staging path for this compile: a
+        :class:`~theanompi_tpu.data.HostStager` (the one copy of the
+        transfer discipline — async ``device_put`` + ``host_load``
+        scope label) always, plus a
+        :class:`~theanompi_tpu.data.StreamingLoader` feed when the
+        ``loader_pipeline`` knob asks for one and the model is not
+        already on a device-resident batch path (the HBM dataset
+        cache moves zero bytes per step — pipelining host transfers
+        that don't happen would only burn a thread)."""
+        from theanompi_tpu.data import (
+            HostStager, StreamingLoader, resolve_loader_depth,
+        )
+
+        self.close_feed()
+        self._stager = HostStager(sharding, dtypes=dtypes)
+        depth = resolve_loader_depth(getattr(self, "config", {}))
+        if not depth:
+            return
+        if (getattr(self, "_device_cache", None) is not None
+                or getattr(self, "_train_scan", None) is not None):
+            import warnings
+
+            warnings.warn(
+                "loader_pipeline requested alongside an active "
+                "device_data_cache path; the HBM cache already moves "
+                "zero bytes per step — streaming feed disabled",
+                stacklevel=3,
+            )
+            return
+        data = self.data
+        self._feed = StreamingLoader(
+            data.train_batch,
+            self._stager.stage,
+            n_batches=lambda: data.n_batch_train,
+            depth=depth,
+            global_batch=int(data.global_batch),
+            sample_ids=getattr(data, "batch_indices", None),
+            journal_meta=self._feed_meta,
+        )
+
+    def _feed_meta(self) -> dict:
+        """Journal stamp for the loader's sample-id accounting: the
+        epoch disambiguates permutation windows across an elastic
+        relaunch; the device count records the world each delivery
+        happened under (the drills' world-history evidence)."""
+        meta = {"epoch": int(self.epoch)}
+        mesh = getattr(self, "mesh", None)
+        if mesh is not None:
+            meta["world"] = int(mesh.devices.size)
+        return meta
+
+    def close_feed(self) -> None:
+        """Stop the streaming feed's producer thread (run() exit;
+        recompiles).  Idempotent; a no-op on the synchronous feed."""
+        feed = getattr(self, "_feed", None)
+        if feed is not None:
+            feed.stop()
+        self._feed = None
+
+    def stage_hlo_text(self) -> str | None:
+        """Optimized HLO of the staging executable — the aux text
+        ``step_profile`` merges into scope attribution so the
+        ``host_load`` leg prices the residual feed cost (the main
+        step's module cannot contain the staging ops: ``device_put``
+        is not a traced op).  None until a batch has been staged."""
+        stager = getattr(self, "_stager", None)
+        return stager.hlo_text() if stager is not None else None
 
 
 class ClassifierModel(TMModel):
@@ -872,6 +950,7 @@ class ClassifierModel(TMModel):
             self.ef_state, ef_spec,
         )
         self._data_sharding = NamedSharding(self.mesh, P(DATA_AXIS))
+        self._init_feed(self._data_sharding)
 
     # -- loss hooks (overridable; GoogLeNet adds aux-classifier terms) -----
 
@@ -900,10 +979,12 @@ class ClassifierModel(TMModel):
     # -- iteration fns (reference: model.train_iter / val_iter) -----------
 
     def put_batch(self, batch):
-        """Shard a host (x, y) batch onto the mesh's data axis."""
-        x, y = batch
-        return jax.device_put(jnp.asarray(x), self._data_sharding), \
-            jax.device_put(jnp.asarray(y), self._data_sharding)
+        """Shard a host (x, y) batch onto the mesh's data axis — via
+        the compile's :class:`~theanompi_tpu.data.HostStager`, the one
+        copy of the transfer discipline (async puts, device ops
+        labelled ``host_load``) shared by the train, val, and
+        streaming-feed paths."""
+        return self._stager.stage(batch)
 
     def _init_device_cache(self) -> None:
         """Stage the WHOLE train set into HBM once (``device_data_cache``
@@ -1168,8 +1249,14 @@ class ClassifierModel(TMModel):
             recorder.train_error(count, loss, err)
             return
         recorder.start()
-        batch = self.data.train_batch(count)
-        x, y = self.put_batch(batch)
+        if self._feed is not None:
+            # pipelined feed: this batch was fetched + staged by the
+            # producer thread UNDER the previous step's compute — the
+            # wait segment is a ring pop
+            x, y = self._feed.next(count)
+        else:
+            batch = self.data.train_batch(count)
+            x, y = self.put_batch(batch)
         recorder.end("wait")
 
         recorder.start()

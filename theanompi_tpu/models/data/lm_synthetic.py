@@ -75,8 +75,15 @@ class MarkovLMData:
     def epoch_permutation(self) -> np.ndarray:
         return self._perm
 
+    def batch_indices(self, i: int) -> np.ndarray:
+        """Sample ids of window ``i`` — the streaming loader's
+        journal key (the elastic drills' zero-lost/dup accounting)."""
+        return self._perm[
+            i * self.global_batch : (i + 1) * self.global_batch
+        ]
+
     def train_batch(self, i: int):
-        sel = self._perm[i * self.global_batch : (i + 1) * self.global_batch]
+        sel = self.batch_indices(i)
         seq = self._train[sel]
         return seq[:, :-1], seq[:, 1:]
 

@@ -1113,6 +1113,9 @@ class Llama(TMModel):
         else:
             self.ef_state = {}
         self._batch_sharding = NamedSharding(mesh, batch_spec)
+        self._init_feed(
+            self._batch_sharding, dtypes=(jnp.int32, jnp.int32)
+        )
 
     def _init_device_cache(self, shard_step) -> None:
         """Stage the whole token set into HBM and compile K-step
@@ -1235,11 +1238,10 @@ class Llama(TMModel):
             self.train_iter(count + j, recorder)
 
     def put_batch(self, batch):
-        x, y = batch
-        return (
-            jax.device_put(jnp.asarray(x, jnp.int32), self._batch_sharding),
-            jax.device_put(jnp.asarray(y, jnp.int32), self._batch_sharding),
-        )
+        # one copy of the transfer discipline (data/HostStager): async
+        # int32 puts onto the batch sharding, device ops labelled
+        # host_load — shared by the train, val, and streaming-feed paths
+        return self._stager.stage(batch)
 
     @property
     def train_step_fn(self):
@@ -1288,7 +1290,12 @@ class Llama(TMModel):
             self._scan_dispatch(self._train_scan1, count, recorder)
             return
         recorder.start()
-        x, y = self.put_batch(self.data.train_batch(count))
+        if self._feed is not None:
+            # pipelined feed: fetched + staged by the producer thread
+            # under the previous step's compute
+            x, y = self._feed.next(count)
+        else:
+            x, y = self.put_batch(self.data.train_batch(count))
         recorder.end("wait")
         recorder.start()
         (

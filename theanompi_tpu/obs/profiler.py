@@ -88,7 +88,9 @@ def _scope_label_re():
     )
 
 
-def profile_scope_sets(hlo_text: str) -> "OrderedDict[str, set]":
+def profile_scope_sets(
+    hlo_text: str, aux_hlo_texts=(),
+) -> "OrderedDict[str, set]":
     """Ordered ``{leg_name: set(instruction names)}`` extracted from
     optimized-HLO text — the ``scopes=`` argument for
     ``trace_comm.comm_report``.
@@ -98,7 +100,16 @@ def profile_scope_sets(hlo_text: str) -> "OrderedDict[str, set]":
     as the leg name (``exchange_b0``, ``exchange_b1``, …).  Leg order
     is exact-label legs first: attribution is first-match-wins, so a
     nested ``exchange_b0/quantize_wire`` op counts as ``quantize``,
-    not as bucket wire time."""
+    not as bucket wire time.
+
+    ``aux_hlo_texts`` — optimized HLO of OTHER executables that run
+    inside the profiled window (the batch-staging ``host_load``
+    module: ``device_put`` is not a traced op, so the feed's device
+    cost can only carry a scope through its own tiny executable).
+    HLO instruction names are module-unique, not trace-unique — an
+    aux module's ``fusion.1`` would claim the main step's ``fusion.1``
+    events — so aux marker names colliding with ANY main-module
+    instruction name are dropped (the PR 6 collision lesson)."""
     from theanompi_tpu.utils.trace_comm import hlo_instr_re
 
     instr_re = hlo_instr_re()
@@ -126,6 +137,17 @@ def profile_scope_sets(hlo_text: str) -> "OrderedDict[str, set]":
     )
     for label in sorted(prefix_legs, key=_bucket_sort_key):
         out[label] = prefix_legs[label]
+    if aux_hlo_texts:
+        from theanompi_tpu.utils.trace_comm import (
+            hlo_instruction_names,
+        )
+
+        main_names = hlo_instruction_names(hlo_text)
+        for aux in aux_hlo_texts:
+            if not aux:
+                continue
+            for leg, ops in profile_scope_sets(aux).items():
+                out.setdefault(leg, set()).update(ops - main_names)
     return out
 
 
@@ -278,6 +300,7 @@ def step_profile(
     run_fn,
     *,
     hlo_text: str,
+    aux_hlo_texts=(),
     n_steps: int,
     n_devices: int,
     name: str = "train_step",
@@ -294,7 +317,10 @@ def step_profile(
 
     ``hlo_text`` — optimized HLO of the step executable
     (``trace_comm.compiled_hlo_text``), the source of the per-scope
-    instruction-name sets.  ``peak_flops`` — per-device peak (the
+    instruction-name sets; ``aux_hlo_texts`` — HLO of other
+    executables in the window (batch staging: ``model.
+    stage_hlo_text()``), collision-filtered per
+    ``profile_scope_sets``.  ``peak_flops`` — per-device peak (the
     MFU denominator); ``step_flops``/``step_bytes`` — one step's
     total FLOPs/bytes across devices (XLA ``cost_analysis``, the
     bench's ``_step_flops`` derivation).
@@ -314,7 +340,7 @@ def step_profile(
 
     from theanompi_tpu.utils import trace_comm
 
-    scopes = profile_scope_sets(hlo_text)
+    scopes = profile_scope_sets(hlo_text, aux_hlo_texts)
     wall_box: list[float] = []
 
     def timed():

@@ -89,13 +89,26 @@ def _headline_row_name(metric: str | None) -> str:
     return "headline"
 
 
+def _add_row(rows: dict, name: str, rec: dict) -> None:
+    """One record → one judged row, PLUS one ``"{name}.{sub}"`` row
+    per entry in its ``subrows`` dict (the loader bench's sync/
+    pipelined A/B arms, PR 16): sub-arms get their own trajectory
+    verdicts instead of hiding inside the parent record, and a
+    subrow first appearing on a capture judges ``new`` (non-fatal),
+    so growing an A/B never reds the gate retroactively."""
+    rows[name] = _row_from_record(rec)
+    for sub, srec in (rec.get("subrows") or {}).items():
+        if isinstance(srec, dict):
+            rows[f"{name}.{sub}"] = _row_from_record(srec)
+
+
 def _rows_from_parsed(parsed: dict) -> dict:
     rows = {}
     if parsed.get("value") is not None or parsed.get("metric"):
-        rows[_headline_row_name(parsed.get("metric"))] = \
-            _row_from_record(parsed)
+        _add_row(rows, _headline_row_name(parsed.get("metric")),
+                 parsed)
     for name, rec in (parsed.get("secondary") or {}).items():
-        rows[str(name)] = _row_from_record(rec)
+        _add_row(rows, str(name), rec)
     return rows
 
 
@@ -176,8 +189,9 @@ def load_capture(path: str | Path) -> dict | None:
     if "rows" in d and isinstance(d["rows"], dict):
         fmt = "rows"
         n = d.get("n")
-        rows = {k: _row_from_record(v) for k, v in d["rows"].items()
-                if isinstance(v, dict)}
+        for k, v in d["rows"].items():
+            if isinstance(v, dict):
+                _add_row(rows, str(k), v)
     elif "parsed" in d or "tail" in d:
         n = d.get("n")
         if isinstance(d.get("parsed"), dict):
@@ -195,6 +209,15 @@ def load_capture(path: str | Path) -> dict | None:
                              "metric": key}
     if fmt is None:
         return None
+    # stamp the capture's platform onto each row: the judge refuses
+    # cross-platform value comparisons (a host-side throughput row
+    # captured on the chip-attached machine vs the CPU container is
+    # not a trajectory, it is two machines) — legacy formats carry
+    # no platform and stay wildcard
+    plat = d.get("platform")
+    if plat is not None:
+        for r in rows.values():
+            r.setdefault("platform", plat)
     return {"name": name, "n": n, "rows": rows, "format": fmt,
             "path": str(path)}
 
@@ -239,8 +262,21 @@ def higher_is_better(row: dict | None) -> bool:
                    for u in LOWER_BETTER_UNITS)
 
 
+def _comparable(cur: dict, prev: dict | None) -> bool:
+    """Whether ``prev`` is a valid comparison point for ``cur``: a
+    row that DECLARES a platform only judges against its own
+    platform's trajectory; a platform-less row (legacy captures, the
+    in-flight bench record) compares against anything — it cannot
+    demand filtering it never stamped."""
+    if prev is None:
+        return False
+    plat = cur.get("platform")
+    return plat is None or prev.get("platform") == plat
+
+
 def trajectory_band(series: list, upto: int,
-                    higher_better: bool = True) -> float:
+                    higher_better: bool = True,
+                    like: dict | None = None) -> float:
     """The row's own accepted step-to-step variability: the largest
     ADVERSE-direction excursion among CONSECUTIVE prior captures
     (indices < ``upto``) that both carry values.  Past adverse moves
@@ -249,11 +285,13 @@ def trajectory_band(series: list, upto: int,
     swing ~30% between identical runs.  Improvements are NOT noise:
     counting a deliberate 2x win into the band would leave the row
     permanently unguardable (a 50% collapse inside a |ratio-1| band
-    of 1.0)."""
+    of 1.0).  With ``like``, only captures comparable to that row's
+    platform contribute (a cross-machine jump is not noise)."""
     vals = [
         row["value"] for _, row in series[:upto]
         if row is not None and row.get("value") is not None
         and row.get("error") is None
+        and (like is None or _comparable(like, row))
     ]
     band = 0.0
     for a, b in zip(vals, vals[1:]):
@@ -272,7 +310,10 @@ def judge(series: list, cur_idx: int | None = None) -> dict:
     with verdict one of ``ok`` / ``improved`` / ``regressed`` /
     ``new`` (no prior capture has the row) / ``error`` (the current
     capture recorded an error for it) / ``absent`` (the current
-    capture does not carry it)."""
+    capture does not carry it).  A row that declares a ``platform``
+    judges only against same-platform priors (cross-machine
+    throughput is two series, not one trajectory) — a row with none
+    carries over prior behavior and compares against anything."""
     if cur_idx is None:
         cur_idx = max(
             (i for i, (_, r) in enumerate(series) if r is not None),
@@ -288,7 +329,8 @@ def judge(series: list, cur_idx: int | None = None) -> dict:
         (i for i in range(cur_idx - 1, -1, -1)
          if series[i][1] is not None
          and series[i][1].get("value") is not None
-         and series[i][1].get("error") is None),
+         and series[i][1].get("error") is None
+         and _comparable(cur, series[i][1])),
         None,
     )
     if prev_idx is None or cur.get("value") is None:
@@ -302,7 +344,8 @@ def judge(series: list, cur_idx: int | None = None) -> dict:
     band = max(
         float(cur.get("spread") or 0.0),
         float(prev.get("spread") or 0.0),
-        trajectory_band(series, prev_idx + 1, higher_better=hib),
+        trajectory_band(series, prev_idx + 1, higher_better=hib,
+                        like=cur),
         BAND_FLOOR,
     )
     out = {

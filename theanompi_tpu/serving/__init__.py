@@ -70,6 +70,10 @@ from theanompi_tpu.serving.kv_transfer import (
 # `theanompi_tpu.serving.paged_attention.paged_attend` directly.
 from theanompi_tpu.serving.prefix_cache import PrefixCache
 from theanompi_tpu.serving.speculation import NGramDrafter
+from theanompi_tpu.serving.tokenize import (
+    ByteTokenizer,
+    TokenizeService,
+)
 from theanompi_tpu.serving.replica import (
     InProcessReplica,
     ReplicaServer,
@@ -86,6 +90,7 @@ __all__ = [
     "Autoscaler",
     "BlockAllocator",
     "BlockManager",
+    "ByteTokenizer",
     "ConsistentHashRing",
     "Engine",
     "InProcessReplica",
@@ -101,6 +106,7 @@ __all__ = [
     "Router",
     "ServingFuture",
     "TCPReplicaClient",
+    "TokenizeService",
     "build_handoff",
     "decoder_from_checkpoint",
     "default_prefill_buckets",

@@ -224,6 +224,7 @@ class Engine:
         drafter=None,
         tracer: Tracer | None = None,
         trace_sample: int = 0,
+        tokenizer=None,
     ):
         self.decoder = decoder
         self.queue_cap = int(queue_cap)
@@ -309,6 +310,20 @@ class Engine:
         if tracer is None and int(trace_sample) > 0:
             tracer = Tracer(process="engine", sample=int(trace_sample))
         self._tracer = tracer
+
+        # batched tokenize/detokenize front door (serving/tokenize.py,
+        # PR 16): text submissions encode through a thread + queue so
+        # request encoding amortizes across concurrent submitters
+        # instead of running per-request on the submit path.  None
+        # unless a tokenizer is wired — submit_text then raises.
+        if tokenizer is not None:
+            from theanompi_tpu.serving.tokenize import TokenizeService
+
+            self._tok_service = TokenizeService(
+                tokenizer, recorder=self.recorder
+            )
+        else:
+            self._tok_service = None
 
     @property
     def tracer(self) -> Tracer | None:
@@ -442,6 +457,28 @@ class Engine:
             n_prompt=len(entry.request.prompt), n_generated=0,
         )
         return entry.future
+
+    def submit_text(self, text: str, **kw) -> ServingFuture:
+        """Submit a request from *text*: encode through the batched
+        tokenize service (concurrent submitters share one codec sweep
+        — serving/tokenize.py), then queue as usual.  Requires the
+        engine to have been built with ``tokenizer=``."""
+        if self._tok_service is None:
+            raise RuntimeError(
+                "submit_text requires Engine(tokenizer=...): no "
+                "tokenize service is wired on this engine"
+            )
+        return self.submit(self._tok_service.tokenize(text), **kw)
+
+    def decode_text(self, ids) -> str:
+        """Detokenize generated ids through the same batching
+        service (the detokenize half of the front door)."""
+        if self._tok_service is None:
+            raise RuntimeError(
+                "decode_text requires Engine(tokenizer=...): no "
+                "tokenize service is wired on this engine"
+            )
+        return self._tok_service.detokenize(ids)
 
     def queue_depth(self) -> int:
         with self._lock:
@@ -1259,16 +1296,19 @@ class Engine:
         """Stop the background loop, draining work submitted BEFORE
         the stop (later submissions shed with reason "shutdown", so
         the drain — and therefore stop() — always terminates)."""
-        if self._thread is None:
-            return
-        self._stop.set()
-        self._thread.join()
-        self._thread = None
-        # belt-and-braces: any entry that slipped in around the final
-        # drain still resolves (the "never a hang" contract)
-        now = time.monotonic()
-        with self._lock:
-            residual = list(self._queue)
-            self._queue.clear()
-        for entry in residual:
-            self._shed(entry, "shutdown", now)
+        if self._thread is not None:
+            self._stop.set()
+            self._thread.join()
+            self._thread = None
+            # belt-and-braces: any entry that slipped in around the
+            # final drain still resolves (the "never a hang" contract)
+            now = time.monotonic()
+            with self._lock:
+                residual = list(self._queue)
+                self._queue.clear()
+            for entry in residual:
+                self._shed(entry, "shutdown", now)
+        # the tokenize worker exists in inline mode too (run_until_idle
+        # engines never start the loop thread) — always stop it
+        if self._tok_service is not None:
+            self._tok_service.stop()

@@ -35,6 +35,13 @@ with actions
   continue at the new dp by resharding its checkpoint
   (docs/RESILIENCE.md elasticity).  Fires once, persisted across
   relaunches like every other action.
+- ``stall_loader`` — the DATA-PLANE drill (``data/pipeline.py``): the
+  streaming loader's producer stops staging for the next
+  ``TM_STALL_LOADER_N`` (default 3) batches, as if the host-side
+  fetch had hit a slow disk / GC pause.  The consumer must DEGRADE —
+  synchronous fetch with the ``starved`` counter ticking — not
+  deadlock; the producer realigns and the stream's sample order is
+  unchanged (the permutation, not the transport, defines it).
 - ``spike_load`` — the AUTOSCALER drill (``serving/autoscaler.py``):
   raise :class:`LoadSpike` out of the autoscaler's policy-loop tick.
   The autoscaler treats the spike as a sustained-backpressure
@@ -63,6 +70,7 @@ from __future__ import annotations
 
 import os
 import signal
+import threading
 import time
 from pathlib import Path
 
@@ -71,7 +79,7 @@ _STATE_ENV = "TM_FAULT_STATE"
 
 ACTIONS = (
     "die", "hang", "sigterm", "corrupt_ckpt", "die_replica",
-    "lose_device", "shrink_world", "spike_load",
+    "lose_device", "shrink_world", "spike_load", "stall_loader",
 )
 
 
@@ -98,9 +106,11 @@ def reset_fault_cache() -> None:
     """Forget the cached ``TM_FAULT_AT`` parse AND the in-process
     fired set, so one process can exercise multiple fault configs
     (tests; parameter sweeps re-entering ``run()``)."""
-    global _parsed, _fired
+    global _parsed, _fired, _loader_stall_n
     _parsed = "unset"
     _fired = set()
+    with _loader_stall_lock:
+        _loader_stall_n = 0
 
 
 def _parse_one(entry: str) -> tuple[int, int, str]:
@@ -134,7 +144,7 @@ def _target() -> list[tuple[int, int, str]] | None:
                     f"{_ENV} must be "
                     f"'<epoch>:<iter>[:die|hang|sigterm|corrupt_ckpt"
                     f"|die_replica|lose_device|shrink_world"
-                    f"|spike_load][,...]', got {raw!r}"
+                    f"|spike_load|stall_loader][,...]', got {raw!r}"
                 ) from err
             if not _parsed:
                 _parsed = None
@@ -173,6 +183,38 @@ def _mark_fired(idx: int) -> None:
         fh.write(f"{idx}\n")
         fh.flush()
         os.fsync(fh.fileno())
+
+
+# -- loader stall (the stall_loader action's channel) ------------------------
+
+#: batches the streaming loader's producer must skip staging for —
+#: set by the ``stall_loader`` action, drained by the producer thread
+#: via ``consume_loader_stall`` (hence the lock: two threads)
+_loader_stall_lock = threading.Lock()
+_loader_stall_n = 0
+
+
+def consume_loader_stall() -> bool:
+    """Polled by the streaming loader's producer once per batch: True
+    means "do not stage this one" (the ``stall_loader`` drill), and
+    one stalled batch is consumed from the pending count."""
+    global _loader_stall_n
+    if _loader_stall_n <= 0:  # unlocked fast path for the hot loop
+        return False
+    with _loader_stall_lock:
+        if _loader_stall_n <= 0:
+            return False
+        _loader_stall_n -= 1
+        return True
+
+
+def _stall_loader() -> None:
+    global _loader_stall_n
+    n = int(os.environ.get("TM_STALL_LOADER_N", "3"))
+    with _loader_stall_lock:
+        _loader_stall_n += n
+    print(f"{_ENV}: loader producer stalled for {n} batches",
+          flush=True)
 
 
 # -- fault actions -----------------------------------------------------------
@@ -273,6 +315,11 @@ def _execute(action: str, epoch: int, it: int,
             f"{_ENV}: spike_load fired at autoscaler {epoch} "
             f"tick {it}"
         )
+    if action == "stall_loader":
+        # the fault returns (like sigterm): the WORKER keeps running;
+        # the producer thread observes the stall on its next poll
+        _stall_loader()
+        return
     if action == "sigterm":
         # planned preemption: the worker's graceful handler (installed
         # by utils/supervisor.install_preemption_handler) sets the

@@ -549,6 +549,11 @@ class ServingRecorder:
             "cap_slot_s": 0.0, "act_slot_s": 0.0,
             "depth_sum": 0, "depth_n": 0, "depth_max": None,
             "drafted": 0, "accepted": 0, "slot_steps": 0,
+            # batched tokenize/detokenize front door (PR 16,
+            # serving/tokenize.py): sweeps = worker drains, items =
+            # requests encoded/decoded, wait = summed queue seconds
+            "tok_sweeps": 0, "tok_items": 0, "tok_tokens": 0,
+            "tok_wait_s": 0.0,
         }
 
     def record_request(
@@ -593,6 +598,24 @@ class ServingRecorder:
         else:
             a["n_shed"] += 1
             a["shed_reasons"][r["finish_reason"]] += 1
+
+    def record_tokenize(
+        self,
+        *,
+        n_items: int,
+        n_tokens: int,
+        wait_s: float = 0.0,
+    ) -> None:
+        """Fold one tokenize-service sweep (``serving/tokenize.py``):
+        how many encode/decode requests the worker drained in one
+        codec call, the tokens they produced/consumed, and their
+        summed queue wait.  items/sweeps is the amortization factor
+        the batching exists for."""
+        a = self._agg
+        a["tok_sweeps"] += 1
+        a["tok_items"] += int(n_items)
+        a["tok_tokens"] += int(n_tokens)
+        a["tok_wait_s"] += float(wait_s)
 
     def record_step(
         self,
@@ -754,8 +777,11 @@ class ServingRecorder:
                       "hit_tokens", "prompt_tokens", "decode_s",
                       "tokens", "cap_slot_s", "act_slot_s",
                       "depth_sum", "depth_n", "drafted", "accepted",
-                      "slot_steps"):
-                a[k] += b[k]
+                      "slot_steps", "tok_sweeps", "tok_items",
+                      "tok_tokens", "tok_wait_s"):
+                # .get: a peer snapshotted before a counter existed
+                # (older replica build) contributes zero, not a crash
+                a[k] += b.get(k, 0)
             a["shed_reasons"].update(b["shed_reasons"])
             a["finish_reasons"].update(b["finish_reasons"])
             if b.get("depth_max") is not None:
@@ -830,6 +856,16 @@ class ServingRecorder:
             ),
             "blocks_in_use_max": self.blocks_in_use_max,
             "blocks_free_min": self.blocks_free_min,
+            # tokenize front door (serving/tokenize.py): items per
+            # sweep is the batching amortization — 1.0 means the
+            # service degenerated to per-request encoding
+            "tokenize_items": a.get("tok_items", 0),
+            "tokenize_tokens": a.get("tok_tokens", 0),
+            "tokenize_wait_s": a.get("tok_wait_s", 0.0),
+            "tokenize_items_per_sweep": (
+                a["tok_items"] / a["tok_sweeps"]
+                if a.get("tok_sweeps") else None
+            ),
         }
 
     def counter_tracks(self, process: str = "serving") -> list:
@@ -909,6 +945,10 @@ class ServingRecorder:
              [(None, s["blocks_in_use_max"])]),
             (f"{p}_blocks_free_min", "gauge",
              [(None, s["blocks_free_min"])]),
+            (f"{p}_tokenize_items_total", "counter",
+             [(None, s["tokenize_items"])]),
+            (f"{p}_tokenize_items_per_sweep", "gauge",
+             [(None, s["tokenize_items_per_sweep"])]),
         ])
 
 

@@ -356,6 +356,60 @@ def bucketed_overlap(
     }
 
 
+def loader_pipeline(
+    *,
+    batch_bytes: float,
+    step_time_s: float,
+    host_bw: float = 2e9,
+    fetch_s: float = 0.0,
+    depth: int = 2,
+) -> dict:
+    """Predicted win of the streaming loader (``loader_pipeline``
+    knob) over the synchronous feed, from batch bytes / host→device
+    bandwidth / compute step time.
+
+    Model:
+
+    - the SYNCHRONOUS feed serializes host work in front of every
+      step: ``t_host = fetch_s + batch_bytes / host_bw`` and
+      ``t_step_sync = t_host + step_time_s`` — the cost the profiler
+      reports as ``host_gap`` (+ the traced ``host_load`` sliver);
+    - the PIPELINED feed runs the same host work on a producer
+      thread UNDER the previous step's compute.  When ``t_host <=
+      step_time_s`` the producer keeps the ring full and the steady
+      state is compute-bound: ``t_step_pipe = step_time_s``,
+      ``host_gap ≈ 0``;
+    - when the producer CANNOT keep up (``t_host > step_time_s``)
+      the ring drains once (depth batches of headroom) and the
+      steady state is producer-bound: every step waits ``t_host -
+      step_time_s`` — the ``starved_frac`` of step time the consumer
+      spends blocked (the loader's degrade path makes this a
+      synchronous fetch, never a deadlock).
+
+    Returns ms legs + fracs in the house predictor shape; the bench
+    ``loader`` row measures the same quantities.
+    """
+    if depth < 2:
+        raise ValueError(f"depth must be >= 2, got {depth}")
+    t_host = fetch_s + (
+        batch_bytes / host_bw if host_bw > 0 else 0.0
+    )
+    t_sync = t_host + step_time_s
+    stall = max(0.0, t_host - step_time_s)
+    t_pipe = step_time_s + stall
+    return {
+        "t_host_ms": t_host * 1e3,
+        "t_step_sync_ms": t_sync * 1e3,
+        "t_step_pipelined_ms": t_pipe * 1e3,
+        "overlap_win_ms": (t_sync - t_pipe) * 1e3,
+        "host_gap_frac_sync": t_host / t_sync if t_sync else 0.0,
+        "host_gap_frac_pipelined": stall / t_pipe if t_pipe else 0.0,
+        "starved_frac": stall / t_pipe if t_pipe else 0.0,
+        "producer_bound": stall > 0.0,
+        "depth": depth,
+    }
+
+
 def elastic_resume_cost(
     *,
     param_bytes: float,

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from theanompi_tpu import launcher as _launcher
+from theanompi_tpu.data import engine_feed as _engine_feed
 from theanompi_tpu.parallel import (
     elastic_center_merge,
     elastic_center_merge_masked,
@@ -184,6 +185,13 @@ def run(
         local_steps = np.zeros(n_workers, np.int64)
 
     data = model.data
+    # pipelined feed (loader_pipeline knob): batches staged by a
+    # producer thread onto the engine's worker-axis sharding, consumed
+    # by train_step_staged — the same A/B as the BSP model's _feed
+    feed = _engine_feed(
+        cfg, data, engine,
+        epoch_of=lambda: model.epoch, world=n_workers,
+    )
     if verbose:
         print(
             f"EASGD: {n_workers} workers, alpha={alpha:.4f} tau={tau}, "
@@ -222,12 +230,17 @@ def run(
             data.shuffle(epoch)
         for i in range(start_iter, data.n_batch_train):
             recorder.start()
-            batch = data.train_batch(i)
+            staged = (
+                feed.next(i) if feed is not None
+                else engine.put_batch(data.train_batch(i))
+            )
             recorder.end("wait")
 
             if speeds is None:
                 recorder.start()
-                loss, err = engine.train_step(batch, model.current_lr)
+                loss, err = engine.train_step_staged(
+                    staged, model.current_lr
+                )
                 recorder.end("calc")
                 # device scalars, materialized lazily (Recorder.flush)
                 recorder.train_error(i, loss, err)
@@ -253,8 +266,8 @@ def run(
                 if not mask.any():
                     continue
                 recorder.start()
-                loss, err = engine.train_step(
-                    batch, model.current_lr,
+                loss, err = engine.train_step_staged(
+                    staged, model.current_lr,
                     step_mask=mask.astype(np.float32),
                 )
                 recorder.end("calc")
@@ -303,6 +316,8 @@ def run(
             model.save(checkpoint_dir, recorder)
         model.epoch += 1
 
+    if feed is not None:
+        feed.stop()
     _adopt_center()  # final/preempted weights = center + momentum
 
     if preempted:
@@ -583,6 +598,8 @@ def _run_distributed(
         status="preempted" if preempted else "completed",
     )
     _sup.uninstall_preemption_handler()
+    if hasattr(model, "close_feed"):
+        model.close_feed()  # park the streaming feed's producer thread
     last_val = recorder.val_records[-1] if recorder.val_records else {}
     return {
         "epochs": model.epoch,

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from theanompi_tpu import launcher as _launcher
+from theanompi_tpu.data import engine_feed as _engine_feed
 from theanompi_tpu.parallel import gossip_matrix_round
 from theanompi_tpu.utils import Recorder, faults as _faults
 from theanompi_tpu.utils import supervisor as _sup
@@ -185,6 +186,13 @@ def run(
     )
 
     data = model.data
+    # pipelined feed (loader_pipeline knob): batches staged by a
+    # producer thread onto the engine's worker-axis sharding, consumed
+    # by train_step_staged — the same A/B as the BSP model's _feed
+    feed = _engine_feed(
+        cfg, data, engine,
+        epoch_of=lambda: model.epoch, world=n_workers,
+    )
     if verbose:
         print(
             f"GoSGD: {n_workers} workers, p={p_push}, "
@@ -203,11 +211,14 @@ def run(
             data.shuffle(epoch)
         for i in range(start_iter, data.n_batch_train):
             recorder.start()
-            batch = data.train_batch(i)
+            staged = (
+                feed.next(i) if feed is not None
+                else engine.put_batch(data.train_batch(i))
+            )
             recorder.end("wait")
 
             recorder.start()
-            loss, err = engine.train_step(batch, model.current_lr)
+            loss, err = engine.train_step_staged(staged, model.current_lr)
             recorder.end("calc")
             # device scalars, materialized lazily (Recorder.flush)
             recorder.train_error(i, loss, err)
@@ -317,6 +328,8 @@ def run(
             model.save(checkpoint_dir, recorder)
         model.epoch += 1
 
+    if feed is not None:
+        feed.stop()
     scores = drain(scores)
     _adopt_best(model, engine, scores)
 
@@ -688,6 +701,8 @@ def _run_distributed(
         status="preempted" if preempted else "completed",
     )
     _sup.uninstall_preemption_handler()
+    if hasattr(model, "close_feed"):
+        model.close_feed()  # park the streaming feed's producer thread
     last_val = recorder.val_records[-1] if recorder.val_records else {}
     return {
         "epochs": model.epoch,

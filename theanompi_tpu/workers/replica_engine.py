@@ -180,19 +180,24 @@ class ReplicaEngine:
 
         self._rng = jax.random.PRNGKey(model.seed + 17)
 
+        from theanompi_tpu.data import HostStager
+
+        self._stager = HostStager(self.batch_sharding)
+
     # -- batches ---------------------------------------------------------
 
     def put_batch(self, batch):
         """Reshape a flat global batch [W*B, ...] to [W, B, ...] and
-        shard the worker axis (each device feeds its own replica)."""
+        shard the worker axis (each device feeds its own replica).
+        The transfer itself rides the shared ``data.HostStager``
+        discipline — async puts, device ops labelled ``host_load`` —
+        so the in-process async loops' feed profiles like the BSP
+        model's and drops into a ``StreamingLoader`` as its stage."""
         x, y = batch
         w = self.n_workers
         x = np.asarray(x).reshape((w, -1) + tuple(x.shape[1:]))
         y = np.asarray(y).reshape((w, -1) + tuple(y.shape[1:]))
-        return (
-            jax.device_put(jnp.asarray(x), self.batch_sharding),
-            jax.device_put(jnp.asarray(y), self.batch_sharding),
-        )
+        return self._stager.stage((x, y))
 
     # -- stepping --------------------------------------------------------
 
